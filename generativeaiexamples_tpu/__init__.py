@@ -1,0 +1,35 @@
+"""generativeaiexamples_tpu — a TPU-native RAG serving framework.
+
+A brand-new framework with the capabilities of NVIDIA's GenerativeAIExamples
+RAG stack (reference: /root/reference, v0.4.0), built from scratch in
+idiomatic JAX/XLA/Pallas/pjit:
+
+- ``models/``    JAX model definitions (Llama-2/CodeLlama, BERT-style e5
+                 embedder, Mixtral MoE) with HF checkpoint importers.
+- ``ops/``       TPU compute primitives: RoPE, RMSNorm, flash/paged attention
+                 (Pallas kernels with jnp fallbacks), sampling, quantized
+                 matmul, on-device top-k retrieval.
+- ``parallel/``  Device-mesh construction and sharding rules (dp/tp/pp/ep/sp
+                 axes over ICI; DCN for multi-host) — the XLA-collectives
+                 answer to the reference's NCCL/mpirun stack
+                 (reference: llm-inference-server/model_server/server.py:78-101).
+- ``engine/``    The TensorRT-LLM/Triton replacement: continuous-batching
+                 scheduler, slotted/paged KV cache, streaming detokenizer,
+                 AOT compile cache.
+- ``serving/``   OpenAI-style HTTP API + Triton-compatible tensor shim
+                 (reference: ensemble_models/llama/ensemble/config.pbtxt:27-117).
+- ``embed/``     jax.jit batch encoder for e5-large-v2-class embedding models
+                 (reference: common/utils.py:270-297).
+- ``retrieval/`` Vector stores: first-party brute/IVF (numpy, on-TPU matmul
+                 top-k, native C++), gated Milvus/pgvector connectors
+                 (reference: common/utils.py:143-225).
+- ``chains/``    The chain server: 3-endpoint HTTP API with pluggable RAG
+                 examples (reference: RetrievalAugmentedGeneration/common/server.py).
+- ``frontend/``  Web chat + knowledge-base UI (reference: frontend/).
+- ``obs/``       OpenTelemetry tracing + first-party TTFT/TPS metrics
+                 (reference: common/tracing.py, tools/observability/).
+- ``tools/``     Evaluation (synthetic QA, RAGAS-style metrics, LLM judge)
+                 and streaming ingest.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,170 @@
+"""Application configuration schema.
+
+Parity with the reference's config tree
+(reference: RetrievalAugmentedGeneration/common/configuration.py:20-170):
+``VectorStoreConfig`` / ``LLMConfig`` / ``TextSplitterConfig`` /
+``EmbeddingConfig`` / ``PromptsConfig`` / ``AppConfig`` — extended with
+TPU-native ``EngineConfig``/``MeshConfig`` sections that replace the
+reference's TRT-LLM engine-build flags
+(reference: llm-inference-server/model_server/__main__.py:33-135).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .configuration import configfield, from_file
+
+# Default prompt templates: Llama-2 [INST] chat formats, parity with
+# reference common/configuration.py:124-156 (PromptsConfig defaults).
+CHAT_TEMPLATE = (
+    "<s>[INST] <<SYS>>\n"
+    "You are a helpful, respectful and honest assistant. Always answer as "
+    "helpfully as possible, while being safe. Please ensure that your "
+    "responses are positive in nature.\n"
+    "<</SYS>>\n\n"
+    "{context_str} {query_str} [/INST]"
+)
+
+RAG_TEMPLATE = (
+    "<s>[INST] <<SYS>>\n"
+    "Use the following context to answer the user's question. If you don't "
+    "know the answer, just say that you don't know, don't try to make up an "
+    "answer.\n"
+    "<</SYS>>\n\n"
+    "<s>[INST] Context: {context_str} Question: {query_str} Only return the "
+    "helpful answer below and nothing else. Helpful answer: [/INST]"
+)
+
+
+@dataclass(frozen=True)
+class VectorStoreConfig:
+    """Reference: common/configuration.py:20-47."""
+    name: str = configfield("name", default="brute",
+                            help_txt="vector store backend: brute | ivf | native | milvus | pgvector")
+    url: str = configfield("url", default="",
+                           help_txt="remote store URL (milvus/pgvector only)")
+    nlist: int = configfield("nlist", default=64,
+                             help_txt="IVF cluster count (reference milvus GPU_IVF_FLAT nlist)")
+    nprobe: int = configfield("nprobe", default=16,
+                              help_txt="IVF clusters probed per query")
+
+
+@dataclass(frozen=True)
+class LLMConfig:
+    """Reference: common/configuration.py:50-72."""
+    server_url: str = configfield("server_url", default="",
+                                  help_txt="URL of the TPU inference server ('' = in-process engine)")
+    model_name: str = configfield("model_name", default="llama-2-7b-chat",
+                                  help_txt="served model name")
+    model_engine: str = configfield("model_engine", default="tpu-jax",
+                                    help_txt="tpu-jax | tpu-http | openai-compat | echo (testing)")
+
+
+@dataclass(frozen=True)
+class TextSplitterConfig:
+    """Reference: common/configuration.py:75-92 (510/200 on e5 tokenizer)."""
+    chunk_size: int = configfield("chunk_size", default=510,
+                                  help_txt="tokens per chunk")
+    chunk_overlap: int = configfield("chunk_overlap", default=200,
+                                     help_txt="token overlap between chunks")
+
+
+@dataclass(frozen=True)
+class EmbeddingConfig:
+    """Reference: common/configuration.py:95-121 (e5-large-v2, 1024-d)."""
+    model_name: str = configfield("model_name", default="intfloat/e5-large-v2",
+                                  help_txt="embedding model")
+    dimensions: int = configfield("dimensions", default=1024,
+                                  help_txt="embedding dimensionality")
+    model_engine: str = configfield("model_engine", default="tpu-jax",
+                                    help_txt="tpu-jax | tpu-http | hash (testing)")
+
+
+@dataclass(frozen=True)
+class PromptsConfig:
+    """Reference: common/configuration.py:124-156."""
+    chat_template: str = configfield("chat_template", default=CHAT_TEMPLATE,
+                                     help_txt="non-KB chat prompt template")
+    rag_template: str = configfield("rag_template", default=RAG_TEMPLATE,
+                                    help_txt="KB-augmented prompt template")
+
+
+@dataclass(frozen=True)
+class RetrieverConfig:
+    """Retrieval behavior defaults (reference: chains.py:117 top-4,
+    common/utils.py:91 1500-token context cap)."""
+    top_k: int = configfield("top_k", default=4, help_txt="documents retrieved per query")
+    max_context_tokens: int = configfield("max_context_tokens", default=1500,
+                                          help_txt="token cap on stuffed retrieved context")
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """TPU device-mesh layout — replaces the reference's TP×PP=world-size
+    process topology (reference: model_server/__init__.py:103-110)."""
+    tp: int = configfield("tp", default=0,
+                          help_txt="tensor-parallel size (0 = all local devices)")
+    pp: int = configfield("pp", default=1, help_txt="pipeline-parallel stages")
+    dp: int = configfield("dp", default=1, help_txt="data-parallel replicas")
+    ep: int = configfield("ep", default=1, help_txt="expert-parallel size (MoE)")
+
+
+@dataclass(frozen=True)
+class EngineConfig:
+    """Serving-engine limits — parity with the reference's engine-build
+    defaults (reference: model_server/__main__.py:81-92 max in/out,
+    ensemble_models/llama/tensorrt_llm/config.pbtxt.j2:29 max batch)."""
+    max_input_length: int = configfield("max_input_length", default=3000)
+    max_output_length: int = configfield("max_output_length", default=512)
+    max_batch_size: int = configfield("max_batch_size", default=128)
+    page_size: int = configfield("page_size", default=128,
+                                 help_txt="KV-cache page size in tokens")
+    prefill_buckets: list[int] = configfield(
+        "prefill_buckets", default_factory=lambda: [128, 512, 1024, 2048, 3072],
+        help_txt="static prefill padding buckets (XLA static shapes)")
+    dtype: str = configfield("dtype", default="bfloat16",
+                             help_txt="activation/weight dtype on TPU")
+    quantization: str = configfield("quantization", default="",
+                                    help_txt="'' | int8 | int4_awq (reference: conversion/llama.py:81-97)")
+
+
+@dataclass(frozen=True)
+class TracingConfig:
+    enabled: bool = configfield("enabled", default=False,
+                                help_txt="enable OpenTelemetry tracing (reference gates on ENABLE_TRACING)")
+    otlp_endpoint: str = configfield("otlp_endpoint", default="http://localhost:4317")
+
+
+@dataclass(frozen=True)
+class AppConfig:
+    """Root config (reference: common/configuration.py:158-170)."""
+    vector_store: VectorStoreConfig = field(default_factory=VectorStoreConfig)
+    llm: LLMConfig = field(default_factory=LLMConfig)
+    text_splitter: TextSplitterConfig = field(default_factory=TextSplitterConfig)
+    embeddings: EmbeddingConfig = field(default_factory=EmbeddingConfig)
+    prompts: PromptsConfig = field(default_factory=PromptsConfig)
+    retriever: RetrieverConfig = field(default_factory=RetrieverConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    engine: EngineConfig = field(default_factory=EngineConfig)
+    tracing: TracingConfig = field(default_factory=TracingConfig)
+
+
+_CONFIG_SINGLETON: AppConfig | None = None
+
+
+def get_config(path: str | None = None, *, reload: bool = False) -> AppConfig:
+    """Load-once config accessor.
+
+    Parity with ``get_config`` (reference: common/utils.py:133-140): reads
+    the file named by ``$APP_CONFIG_FILE`` unless an explicit path is given.
+    """
+    global _CONFIG_SINGLETON
+    if path is not None:
+        # Explicit-path loads are one-off: they must not reconfigure every
+        # later bare get_config() caller in the process.
+        return from_file(AppConfig, path)
+    if _CONFIG_SINGLETON is None or reload:
+        import os
+        _CONFIG_SINGLETON = from_file(AppConfig, os.environ.get("APP_CONFIG_FILE"))
+    return _CONFIG_SINGLETON

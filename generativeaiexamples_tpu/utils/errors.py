@@ -1,0 +1,64 @@
+"""Typed exception hierarchy for the framework.
+
+Parity with the reference's ``ModelServerException`` hierarchy
+(reference: llm-inference-server/model_server/errors.py:20-32), extended to
+cover the whole stack. Keeping errors typed lets the serving entrypoint write
+k8s termination logs with unwound causes
+(reference: model_server/__main__.py:159-193).
+"""
+
+from __future__ import annotations
+
+
+class FrameworkError(Exception):
+    """Base class for all first-party errors."""
+
+
+class ConfigError(FrameworkError):
+    """Invalid or missing configuration."""
+
+
+class ModelLoadError(FrameworkError):
+    """A checkpoint could not be found, sniffed, or imported."""
+
+
+class UnsupportedFormatError(ModelLoadError):
+    """Checkpoint format not recognized (reference: model_server/model.py:147-173)."""
+
+
+class ShardingError(FrameworkError):
+    """Invalid mesh/sharding request (e.g. TP*PP != device count;
+    reference: model_server/__init__.py:103-110)."""
+
+
+class EngineError(FrameworkError):
+    """Inference-engine runtime failure."""
+
+
+class SchedulerFullError(EngineError):
+    """No free KV slots / queue capacity for a new request."""
+
+
+class RetrievalError(FrameworkError):
+    """Vector-store failure."""
+
+
+class ChainError(FrameworkError):
+    """Chain-server / example pipeline failure."""
+
+
+def unwind_causes(exc: BaseException) -> list[str]:
+    """Flatten an exception chain into printable lines, innermost last.
+
+    Mirrors the nested-cause unwinding the reference writes to the k8s
+    termination log (reference: model_server/__main__.py:168-186).
+    """
+    lines: list[str] = []
+    seen: set[int] = set()
+    cur: BaseException | None = exc
+    while cur is not None and id(cur) not in seen:
+        seen.add(id(cur))
+        lines.append(f"{type(cur).__name__}: {cur}")
+        cur = cur.__cause__ or (
+            None if cur.__suppress_context__ else cur.__context__)
+    return lines

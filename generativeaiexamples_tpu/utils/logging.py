@@ -1,0 +1,40 @@
+"""Logging bootstrap: pid-tagged format + verbosity flags.
+
+Parity with the reference's logging setup
+(reference: llm-inference-server/model_server/__main__.py:28,138-156).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+LOG_FORMAT = "%(levelname)s %(asctime)s %(process)d %(name)s: %(message)s"
+
+
+def bootstrap_logging(verbosity: int = 0) -> None:
+    """Configure root logging. verbosity: -1 quiet, 0 info, >=1 debug
+    (reference maps -v/-q argparse counts the same way,
+    model_server/__main__.py:66-78)."""
+    level = logging.DEBUG if verbosity >= 1 else (
+        logging.WARNING if verbosity < 0 else logging.INFO)
+    logging.basicConfig(stream=sys.stderr, format=LOG_FORMAT, level=level, force=True)
+
+
+def get_logger(name: str) -> logging.Logger:
+    return logging.getLogger(name)
+
+
+def write_termination_log(message: str, path: str | None = None) -> None:
+    """Write a k8s termination log if the path is writable.
+
+    Parity with the reference's termination-log handler
+    (reference: model_server/__main__.py:159-193).
+    """
+    path = path or os.environ.get("TERMINATION_LOG_PATH", "/dev/termination-log")
+    try:
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(message)
+    except OSError:
+        logging.getLogger(__name__).debug("no termination log at %s", path)

@@ -1,0 +1,107 @@
+"""Config-system tests (schema parity: reference common/configuration.py)."""
+
+import io
+import json
+
+import pytest
+
+from generativeaiexamples_tpu.utils.app_config import AppConfig, get_config
+from generativeaiexamples_tpu.utils.configuration import (
+    asdict, from_dict, from_file, print_help, update_dict)
+from generativeaiexamples_tpu.utils.errors import ConfigError
+
+
+def test_defaults():
+    cfg = from_dict(AppConfig, {})
+    assert cfg.text_splitter.chunk_size == 510
+    assert cfg.text_splitter.chunk_overlap == 200
+    assert cfg.embeddings.dimensions == 1024
+    assert cfg.embeddings.model_name == "intfloat/e5-large-v2"
+    assert cfg.retriever.top_k == 4
+    assert cfg.retriever.max_context_tokens == 1500
+    assert cfg.engine.max_input_length == 3000
+    assert cfg.engine.max_output_length == 512
+    assert cfg.engine.max_batch_size == 128
+    assert cfg.vector_store.nlist == 64 and cfg.vector_store.nprobe == 16
+    assert "[INST]" in cfg.prompts.rag_template
+
+
+def test_file_overlay(tmp_path):
+    p = tmp_path / "config.json"
+    p.write_text(json.dumps({
+        "vector_store": {"name": "ivf", "nlist": 128},
+        "llm": {"model_name": "llama-2-13b-chat"},
+    }))
+    cfg = from_file(AppConfig, str(p))
+    assert cfg.vector_store.name == "ivf"
+    assert cfg.vector_store.nlist == 128
+    assert cfg.vector_store.nprobe == 16  # untouched default
+    assert cfg.llm.model_name == "llama-2-13b-chat"
+
+
+def test_yaml_file(tmp_path):
+    p = tmp_path / "config.yaml"
+    p.write_text("llm:\n  model_engine: echo\nengine:\n  page_size: 64\n")
+    cfg = from_file(AppConfig, str(p))
+    assert cfg.llm.model_engine == "echo"
+    assert cfg.engine.page_size == 64
+
+
+def test_env_overlay_wins_over_file(tmp_path, monkeypatch):
+    p = tmp_path / "config.json"
+    p.write_text(json.dumps({"llm": {"model_name": "from-file"}}))
+    monkeypatch.setenv("APP_LLM_MODELNAME", "from-env")
+    cfg = from_file(AppConfig, str(p))
+    assert cfg.llm.model_name == "from-env"
+
+
+def test_env_coercion(monkeypatch):
+    monkeypatch.setenv("APP_ENGINE_MAXBATCHSIZE", "32")
+    monkeypatch.setenv("APP_TRACING_ENABLED", "true")
+    cfg = from_dict(AppConfig, {})
+    assert cfg.engine.max_batch_size == 32
+    assert cfg.tracing.enabled is True
+
+
+def test_missing_file_is_defaults():
+    cfg = from_file(AppConfig, "/nonexistent/config.yaml")
+    assert cfg.llm.model_engine == "tpu-jax"
+
+
+def test_asdict_roundtrip():
+    cfg = from_dict(AppConfig, {})
+    d = asdict(cfg)
+    assert d["text_splitter"]["chunk_size"] == 510
+    cfg2 = from_dict(AppConfig, d)
+    assert cfg2 == cfg
+
+
+def test_print_help_lists_every_section():
+    buf = io.StringIO()
+    print_help(AppConfig, stream=buf)
+    text = buf.getvalue()
+    for section in ("vector_store", "llm", "text_splitter", "embeddings",
+                    "prompts", "retriever", "mesh", "engine", "tracing"):
+        assert section in text
+    assert "APP_LLM_MODELNAME" in text
+
+
+def test_update_dict_deep_merge():
+    base = {"a": {"b": 1, "c": 2}, "d": 3}
+    out = update_dict(base, {"a": {"b": 9}, "e": 4})
+    assert out == {"a": {"b": 9, "c": 2}, "d": 3, "e": 4}
+    assert base["a"]["b"] == 1  # no mutation
+
+
+def test_get_config_singleton(tmp_path, monkeypatch):
+    p = tmp_path / "c.yaml"
+    p.write_text("llm:\n  model_name: singleton-test\n")
+    monkeypatch.setenv("APP_CONFIG_FILE", str(p))
+    cfg = get_config(reload=True)
+    assert cfg.llm.model_name == "singleton-test"
+    assert get_config() is cfg
+
+
+def test_bad_coercion_raises():
+    with pytest.raises((ConfigError, ValueError)):
+        from_dict(AppConfig, {"engine": {"max_batch_size": "not-a-number"}})
