@@ -1,0 +1,129 @@
+"""End-to-end serving benchmark (run on real TPU hardware by the driver).
+
+Measures the canonical QA-chatbot serving path through the real engine
+(continuous batching, streaming): p50 time-to-first-token and aggregate
+decode throughput. Baseline: the north-star <200 ms p50 TTFT for the
+llama-2-7b chatbot (BASELINE.json; the reference publishes no numbers of
+its own — BASELINE.md).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "ms", "vs_baseline": N, ...}
+``vs_baseline`` = baseline_ms / measured_ms (>1 ⇒ beating the target).
+
+Env knobs: BENCH_MODEL (default llama-2-7b-chat; falls back to llama-1b on
+OOM), BENCH_PROMPT_LEN, BENCH_OUTPUT_LEN, BENCH_REQUESTS, BENCH_SLOTS.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+TTFT_BASELINE_MS = 200.0
+
+
+def build_engine(model_name: str, slots: int, prompt_len: int, out_len: int):
+    import jax
+    import jax.numpy as jnp
+
+    from generativeaiexamples_tpu.engine import Engine, EngineConfig
+    from generativeaiexamples_tpu.models import llama
+    from generativeaiexamples_tpu.models.configs import get_model_config
+    from generativeaiexamples_tpu.models.tokenizer import ByteTokenizer
+
+    cfg = get_model_config(model_name)
+    params = jax.jit(
+        lambda key: llama.init_params(cfg, key, dtype=jnp.bfloat16)
+    )(jax.random.key(0))
+    jax.block_until_ready(params)
+
+    bucket = max(64, prompt_len)
+    ecfg = EngineConfig(max_slots=slots, max_input_length=bucket,
+                        max_output_length=out_len,
+                        prefill_buckets=(bucket,), dtype="bfloat16")
+    return Engine(params, cfg, ByteTokenizer(), ecfg)
+
+
+def run_bench(engine, prompt_len: int, out_len: int, n_requests: int,
+              slots: int):
+    from generativeaiexamples_tpu.engine import SamplingParams
+
+    prompt_ids = list(range(3, 3 + 250)) * (prompt_len // 250 + 1)
+    prompt_ids = prompt_ids[:prompt_len]
+    sp = SamplingParams(max_tokens=out_len, top_k=1, ignore_eos=True)
+
+    # Warmup: compile prefill/insert/decode.
+    engine.start()
+    engine.submit(prompt_ids, SamplingParams(max_tokens=4, top_k=1,
+                                             ignore_eos=True)).text()
+
+    # TTFT: sequential requests against an idle engine (the reference's
+    # single-user chat scenario).
+    ttfts = []
+    for _ in range(n_requests):
+        stream = engine.submit(prompt_ids, SamplingParams(
+            max_tokens=2, top_k=1, ignore_eos=True))
+        stream.text()
+        ttfts.append(stream.ttft_ms)
+    ttfts.sort()
+    p50 = ttfts[len(ttfts) // 2]
+    p99 = ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.99))]
+
+    # Throughput: saturate the decode batch.
+    t0 = time.monotonic()
+    streams = [engine.submit(prompt_ids, sp) for _ in range(slots)]
+    total_tokens = 0
+    for s in streams:
+        s.text()
+        total_tokens += len(s.token_ids)
+    dt = time.monotonic() - t0
+    tput = total_tokens / dt
+    return p50, p99, tput
+
+
+def main() -> None:
+    model = os.environ.get("BENCH_MODEL", "llama-2-7b-chat")
+    prompt_len = int(os.environ.get("BENCH_PROMPT_LEN", "512"))
+    out_len = int(os.environ.get("BENCH_OUTPUT_LEN", "64"))
+    n_requests = int(os.environ.get("BENCH_REQUESTS", "8"))
+    slots = int(os.environ.get("BENCH_SLOTS", "4"))
+
+    t_start = time.monotonic()
+    try:
+        engine = build_engine(model, slots, prompt_len, out_len)
+    except Exception as exc:  # OOM on small chips: degrade, keep the signal
+        sys.stderr.write(f"bench: {model} failed ({type(exc).__name__}: "
+                         f"{exc}); falling back to llama-1b\n")
+        model = "llama-1b"
+        engine = build_engine(model, slots, prompt_len, out_len)
+
+    try:
+        p50, p99, tput = run_bench(engine, prompt_len, out_len, n_requests,
+                                   slots)
+    finally:
+        engine.stop()
+
+    import jax
+    result = {
+        "metric": f"p50_ttft_ms_{model.replace('-', '_')}",
+        "value": round(p50, 2),
+        "unit": "ms",
+        "vs_baseline": round(TTFT_BASELINE_MS / p50, 3),
+        "p99_ttft_ms": round(p99, 2),
+        "decode_tokens_per_sec": round(tput, 1),
+        "prompt_len": prompt_len,
+        "output_len": out_len,
+        "slots": slots,
+        "device": jax.devices()[0].device_kind,
+        "n_devices": jax.device_count(),
+        "wall_s": round(time.monotonic() - t_start, 1),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,5 @@
+"""On-device batch embedding service."""
+
+from .encoder import EmbeddingService, HashEmbedder, get_embedder
+
+__all__ = ["EmbeddingService", "HashEmbedder", "get_embedder"]

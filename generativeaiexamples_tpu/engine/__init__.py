@@ -1,0 +1,13 @@
+"""The serving engine: continuous batching over a slotted KV cache.
+
+This package replaces the reference's entire TRT-LLM serving core — the
+Triton C++ backend with inflight fused batching, paged KV, and decoupled
+streaming (reference: ensemble_models/llama/tensorrt_llm/config.pbtxt.j2,
+model_server/server.py:40-71) — with a jit-compiled JAX program driven by a
+host-side scheduler thread.
+"""
+
+from .sampling_params import SamplingParams
+from .engine import Engine, EngineConfig
+
+__all__ = ["SamplingParams", "Engine", "EngineConfig"]

@@ -1,0 +1,89 @@
+"""Incremental streaming detokenizer.
+
+Parity with the reference's per-token Python detokenizer model
+(reference: ensemble_models/llama/postprocessing/1/model.py:131-154 —
+``_id_to_token`` handles sentencepiece SPACE/NEWLINE sentinel chars), done
+robustly: decode the full id sequence each step and emit the stable prefix
+diff, holding back trailing bytes that are still an incomplete UTF-8 /
+sentencepiece fragment.
+"""
+
+from __future__ import annotations
+
+from ..models.tokenizer import Tokenizer
+
+
+class IncrementalDetokenizer:
+    """Feed token ids one at a time; get back printable text chunks."""
+
+    def __init__(self, tokenizer: Tokenizer):
+        self._tok = tokenizer
+        self._ids: list[int] = []
+        self._emitted = 0  # chars already yielded
+
+    def push(self, token_id: int) -> str:
+        self._ids.append(token_id)
+        text = self._tok.decode(self._ids)
+        # Hold back a trailing replacement char: it usually means the last
+        # token ends mid-UTF-8-sequence and the next token completes it.
+        safe_end = len(text)
+        if text.endswith("�"):
+            safe_end = len(text) - 1
+        if safe_end <= self._emitted:
+            return ""
+        chunk = text[self._emitted:safe_end]
+        self._emitted = safe_end
+        return chunk
+
+    def flush(self) -> str:
+        text = self._tok.decode(self._ids)
+        chunk = text[self._emitted:]
+        self._emitted = len(text)
+        return chunk
+
+    @property
+    def text(self) -> str:
+        return self._tok.decode(self._ids)
+
+
+class StopChecker:
+    """Stop-word scanning over the accumulated stream.
+
+    Parity with the client-side stop-word drain in the reference
+    (reference: model_server_client/trt_llm.py:211-223 — it scans the
+    accumulated text for stop strings and truncates). Returns the emittable
+    portion of each chunk while withholding text that could be the start of
+    a stop word.
+    """
+
+    def __init__(self, stop_words: list[str]):
+        self._stops = [s for s in stop_words if s]
+        self._buf = ""
+        self.stopped = False
+
+    def feed(self, chunk: str) -> str:
+        if self.stopped:
+            return ""
+        self._buf += chunk
+        for stop in self._stops:
+            idx = self._buf.find(stop)
+            if idx >= 0:
+                self.stopped = True
+                out, self._buf = self._buf[:idx], ""
+                return out
+        # Withhold the longest suffix that is a prefix of any stop word.
+        hold = 0
+        for stop in self._stops:
+            for n in range(min(len(stop) - 1, len(self._buf)), 0, -1):
+                if self._buf.endswith(stop[:n]):
+                    hold = max(hold, n)
+                    break
+        if hold:
+            out, self._buf = self._buf[:-hold], self._buf[-hold:]
+        else:
+            out, self._buf = self._buf, ""
+        return out
+
+    def flush(self) -> str:
+        out, self._buf = self._buf, ""
+        return "" if self.stopped else out

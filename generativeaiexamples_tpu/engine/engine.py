@@ -1,0 +1,396 @@
+"""Continuous-batching inference engine.
+
+The TPU-native replacement for the reference's Triton + TRT-LLM C++ serving
+core with "inflight fused batching"
+(reference: ensemble_models/llama/tensorrt_llm/config.pbtxt.j2:28-34,
+model_server/server.py:67-71). Architecture:
+
+- **Decode slots.** A fixed-size batch of KV-cache slots (static shapes for
+  XLA). Every decode step runs the whole slot batch through one jitted
+  program; inactive slots are masked. This is inflight batching: requests
+  join and leave the batch between steps, the compiled program never changes.
+- **Bucketed prefill.** Prompts are padded to the nearest static bucket and
+  prefilled as a separate jitted call (one compile per bucket), then their
+  KV is scattered into a free slot — the prefill/decode disaggregation that
+  TRT-LLM's fused batching does inside C++.
+- **Host-side scheduler thread.** Python owns admission, retirement, stop
+  words, and streaming; the device owns math. The per-step host<->device
+  traffic is one (B,) token vector.
+- **Streaming.** Each request gets a thread-safe ``TokenStream`` — the
+  decoupled-response equivalent of the reference's gRPC streaming callbacks
+  (reference: model_server_client/trt_llm.py:417-442).
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Iterator, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models import llama
+from ..models.configs import LlamaConfig
+from ..models.tokenizer import Tokenizer
+from ..ops.sampling import sample
+from ..parallel.sharding import kv_cache_spec, llama_param_specs, shard_params
+from ..utils.errors import EngineError, SchedulerFullError
+from .detokenizer import IncrementalDetokenizer, StopChecker
+from .sampling_params import SamplingParams
+
+
+@dataclass(frozen=True)
+class EngineConfig:
+    """Engine sizing. Defaults mirror the reference's engine limits
+    (reference: model_server/__main__.py:81-92, config.pbtxt.j2:29)."""
+    max_slots: int = 8                # concurrent decode requests
+    max_input_length: int = 3000
+    max_output_length: int = 512
+    prefill_buckets: tuple[int, ...] = (128, 512, 1024, 2048, 3072)
+    dtype: str = "bfloat16"
+    seed: int = 0
+    max_queue: int = 256
+
+    @property
+    def max_cache_len(self) -> int:
+        return self.max_input_length + self.max_output_length
+
+
+class TokenStream:
+    """Thread-safe stream of text chunks for one request."""
+
+    def __init__(self, request_id: int):
+        self.request_id = request_id
+        self._q: "queue.Queue[tuple[str, object]]" = queue.Queue()
+        self.finish_reason: Optional[str] = None
+        self.token_ids: list[int] = []
+        self.submit_time = time.monotonic()
+        self.first_token_time: Optional[float] = None
+        self.finish_time: Optional[float] = None
+
+    def _put_chunk(self, text: str) -> None:
+        if text:
+            self._q.put(("chunk", text))
+
+    def _finish(self, reason: str) -> None:
+        self.finish_reason = reason
+        self.finish_time = time.monotonic()
+        self._q.put(("done", reason))
+
+    def _fail(self, exc: BaseException) -> None:
+        self.finish_reason = "error"
+        self._q.put(("error", exc))
+
+    def __iter__(self) -> Iterator[str]:
+        while True:
+            kind, payload = self._q.get()
+            if kind == "chunk":
+                yield payload  # type: ignore[misc]
+            elif kind == "error":
+                raise EngineError("engine failure") from payload  # type: ignore[arg-type]
+            else:
+                return
+
+    def text(self) -> str:
+        """Block until completion, return the full generation."""
+        return "".join(self)
+
+    @property
+    def ttft_ms(self) -> Optional[float]:
+        if self.first_token_time is None:
+            return None
+        return (self.first_token_time - self.submit_time) * 1e3
+
+
+@dataclass
+class _Request:
+    stream: TokenStream
+    prompt_ids: list[int]
+    params: SamplingParams
+    detok: IncrementalDetokenizer
+    stop: StopChecker
+    generated: int = 0
+
+
+class Engine:
+    """Continuous-batching engine over one model + mesh."""
+
+    def __init__(self, params: llama.Params, model_cfg: LlamaConfig,
+                 tokenizer: Tokenizer, cfg: EngineConfig = EngineConfig(),
+                 mesh: Optional[Mesh] = None):
+        self.model_cfg = model_cfg
+        self.cfg = cfg
+        self.tokenizer = tokenizer
+        self.mesh = mesh
+        self._dtype = jnp.dtype(cfg.dtype)
+        B, T = cfg.max_slots, cfg.max_cache_len
+
+        if mesh is not None:
+            params = shard_params(params, mesh, llama_param_specs(model_cfg, mesh))
+        self.params = params
+
+        cache = llama.init_kv_cache(model_cfg, B, T, self._dtype)
+        if mesh is not None:
+            cache = jax.tree.map(
+                lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+                cache, kv_cache_spec(model_cfg, mesh))
+# Distinct arrays per field: donated jit args must not alias.
+        self._state = {
+            "cache": cache,
+            "pos": jnp.zeros((B,), jnp.int32),
+            "last_token": jnp.zeros((B,), jnp.int32),
+            "active": jnp.zeros((B,), bool),
+            "temp": jnp.zeros((B,), jnp.float32),
+            "top_k": jnp.zeros((B,), jnp.int32),
+            "top_p": jnp.zeros((B,), jnp.float32),
+        }
+        self._base_key = jax.random.key(cfg.seed)
+        self._step_counter = itertools.count()
+        self._req_counter = itertools.count()
+
+        self._slots: dict[int, _Request] = {}
+        self._free_slots = list(range(B))
+        self._pending: "queue.Queue[tuple[_Request, SamplingParams]]" = (
+            queue.Queue(maxsize=cfg.max_queue))
+        self._wake = threading.Event()
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._fatal: Optional[BaseException] = None
+
+        self.stats = {"requests": 0, "tokens_generated": 0, "decode_steps": 0,
+                      "prefills": 0}
+
+        self._build_jitted()
+
+    # ------------------------------------------------------------------ jit
+
+    def _build_jitted(self) -> None:
+        cfg, mcfg = self.cfg, self.model_cfg
+
+        def prefill(params, tokens, length, temp, top_k, top_p, key):
+            """tokens: (1, S_bucket); returns (k,v) for the bucket, the
+            sampled first token, and the last-token logits."""
+            S = tokens.shape[1]
+            positions = jnp.arange(S, dtype=jnp.int32)[None, :]
+            cache = llama.init_kv_cache(mcfg, 1, S, self._dtype)
+            logits, cache = llama.apply(params, mcfg, tokens, positions,
+                                        cache, kv_valid_len=length[None])
+            last = jnp.take_along_axis(
+                logits, (length - 1)[None, None, None].astype(jnp.int32),
+                axis=1)[0, 0]  # (V,)
+            first_tok = sample(last[None, :], key, temp[None], top_k[None],
+                               top_p[None])[0]
+            return cache["k"], cache["v"], first_tok
+
+        def insert(state, k_new, v_new, slot, length, first_tok,
+                   temp, top_k, top_p):
+            cache = state["cache"]
+            zeros5 = (0, slot, 0, 0, 0)
+            cache = {
+                "k": jax.lax.dynamic_update_slice(
+                    cache["k"], k_new.astype(cache["k"].dtype),
+                    (0, slot, 0, 0, 0)),
+                "v": jax.lax.dynamic_update_slice(
+                    cache["v"], v_new.astype(cache["v"].dtype), zeros5),
+            }
+            return {
+                "cache": cache,
+                "pos": state["pos"].at[slot].set(length),
+                "last_token": state["last_token"].at[slot].set(first_tok),
+                "active": state["active"].at[slot].set(True),
+                "temp": state["temp"].at[slot].set(temp),
+                "top_k": state["top_k"].at[slot].set(top_k),
+                "top_p": state["top_p"].at[slot].set(top_p),
+            }
+
+        def decode_step(params, state, key):
+            pos = state["pos"]
+            active = state["active"]
+            tokens = state["last_token"][:, None]
+            positions = pos[:, None]
+            logits, cache = llama.apply(params, mcfg, tokens, positions,
+                                        state["cache"], kv_valid_len=pos + 1)
+            next_tok = sample(logits[:, 0], key, state["temp"],
+                              state["top_k"], state["top_p"])
+            next_tok = jnp.where(active, next_tok, 0)
+            new_state = dict(state)
+            new_state["cache"] = cache
+            new_state["pos"] = jnp.where(active, pos + 1, pos)
+            new_state["last_token"] = next_tok
+            return new_state, next_tok
+
+        def release(state, slot):
+            return dict(state, active=state["active"].at[slot].set(False))
+
+        self._prefill = jax.jit(prefill)
+        self._insert = jax.jit(insert, donate_argnums=(0,))
+        self._decode_step = jax.jit(decode_step, donate_argnums=(1,))
+        self._release = jax.jit(release, donate_argnums=(0,))
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._run, daemon=True,
+                                            name="engine-loop")
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+
+    def __enter__(self) -> "Engine":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ------------------------------------------------------------------ API
+
+    def submit(self, prompt_ids: Sequence[int],
+               params: Optional[SamplingParams] = None) -> TokenStream:
+        """Enqueue a request; returns its stream immediately."""
+        if self._fatal is not None:
+            raise EngineError("engine is dead") from self._fatal
+        params = params or SamplingParams()
+        if len(prompt_ids) > self.cfg.max_input_length:
+            raise EngineError(
+                f"prompt length {len(prompt_ids)} exceeds max_input_length "
+                f"{self.cfg.max_input_length}")
+        if len(prompt_ids) == 0:
+            raise EngineError("empty prompt")
+        stream = TokenStream(next(self._req_counter))
+        req = _Request(stream=stream, prompt_ids=list(prompt_ids),
+                       params=params,
+                       detok=IncrementalDetokenizer(self.tokenizer),
+                       stop=StopChecker(params.stop_words))
+        try:
+            self._pending.put_nowait((req, params))
+        except queue.Full:
+            raise SchedulerFullError(
+                f"request queue full ({self.cfg.max_queue})") from None
+        if self._fatal is not None:
+            # The loop may have died between the check above and the put;
+            # fail the stream here so callers never block forever.
+            stream._fail(self._fatal)
+        self.stats["requests"] += 1
+        self._wake.set()
+        return stream
+
+    def generate_text(self, prompt: str,
+                      params: Optional[SamplingParams] = None) -> str:
+        """Sync convenience: tokenize, generate, detokenize."""
+        self.start()
+        ids = self.tokenizer.encode(prompt)
+        return self.submit(ids, params).text()
+
+    def stream_text(self, prompt: str,
+                    params: Optional[SamplingParams] = None) -> TokenStream:
+        self.start()
+        return self.submit(self.tokenizer.encode(prompt), params)
+
+    # ------------------------------------------------------------ scheduler
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.cfg.prefill_buckets:
+            if n <= b:
+                return b
+        return self.cfg.max_input_length
+
+    def _run(self) -> None:
+        try:
+            while not self._stopped.is_set():
+                did_work = self._admit()
+                if self._slots:
+                    self._step()
+                    did_work = True
+                if not did_work:
+                    self._wake.wait(timeout=0.05)
+                    self._wake.clear()
+        except BaseException as exc:  # noqa: BLE001 - report to all streams
+            self._fatal = exc
+            for req in list(self._slots.values()):
+                req.stream._fail(exc)
+            while not self._pending.empty():
+                try:
+                    self._pending.get_nowait()[0].stream._fail(exc)
+                except queue.Empty:
+                    break
+
+    def _admit(self, max_prefills: int = 4) -> bool:
+        admitted = False
+        while self._free_slots and max_prefills > 0:
+            try:
+                req, sp = self._pending.get_nowait()
+            except queue.Empty:
+                break
+            slot = self._free_slots.pop()
+            bucket = self._bucket_for(len(req.prompt_ids))
+            ids = req.prompt_ids + [0] * (bucket - len(req.prompt_ids))
+            tokens = jnp.asarray(np.asarray(ids, np.int32)[None, :])
+            length = jnp.int32(len(req.prompt_ids))
+            key = jax.random.fold_in(self._base_key,
+                                     next(self._step_counter) ^ sp.random_seed)
+            k_new, v_new, first_tok = self._prefill(
+                self.params, tokens, length,
+                jnp.float32(sp.temperature), jnp.int32(sp.top_k),
+                jnp.float32(sp.top_p), key)
+            self._state = self._insert(
+                self._state, k_new, v_new, jnp.int32(slot), length, first_tok,
+                jnp.float32(sp.temperature), jnp.int32(sp.top_k),
+                jnp.float32(sp.top_p))
+            self.stats["prefills"] += 1
+            self._slots[slot] = req
+            self._emit(slot, req, int(first_tok))
+            admitted = True
+            max_prefills -= 1
+        return admitted
+
+    def _step(self) -> None:
+        key = jax.random.fold_in(self._base_key, next(self._step_counter))
+        self._state, next_tok = self._decode_step(self.params, self._state, key)
+        self.stats["decode_steps"] += 1
+        toks = np.asarray(next_tok)
+        for slot, req in list(self._slots.items()):
+            self._emit(slot, req, int(toks[slot]))
+
+    def _emit(self, slot: int, req: _Request, token: int) -> None:
+        """Deliver one generated token; retire the request if finished."""
+        req.generated += 1
+        req.stream.token_ids.append(token)
+        self.stats["tokens_generated"] += 1
+        if req.stream.first_token_time is None:
+            req.stream.first_token_time = time.monotonic()
+
+        finish: Optional[str] = None
+        if token == self.tokenizer.eos_id and not req.params.ignore_eos:
+            finish = "eos"
+        elif req.generated >= req.params.max_tokens:
+            finish = "length"
+        elif len(req.prompt_ids) + req.generated >= self.cfg.max_cache_len:
+            finish = "length"
+
+        if finish != "eos":  # eos token itself is not emitted as text
+            chunk = req.stop.feed(req.detok.push(token))
+            req.stream._put_chunk(chunk)
+            if req.stop.stopped:
+                finish = "stop"
+
+        if finish is not None:
+            if finish in ("eos", "length"):
+                # Emit any text withheld as a potential stop-word prefix.
+                req.stream._put_chunk(req.stop.flush())
+            del self._slots[slot]
+            self._free_slots.append(slot)
+            self._state = self._release(self._state, jnp.int32(slot))
+            req.stream._finish(finish)

@@ -1,0 +1,31 @@
+"""Per-request sampling parameters.
+
+Field-for-field parity with the reference's ensemble tensor API
+(reference: ensemble_models/llama/ensemble/config.pbtxt:27-117 and the
+client defaults in model_server_client/trt_llm.py:68-74: tokens=100,
+top_k=1, top_p=0, temperature=1.0, beam_width=1).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class SamplingParams:
+    max_tokens: int = 100
+    temperature: float = 1.0
+    top_k: int = 1
+    top_p: float = 0.0
+    repetition_penalty: float = 1.0
+    length_penalty: float = 1.0       # accepted for API parity (beam=1 ⇒ no-op)
+    beam_width: int = 1               # only 1 supported, like TRT default
+    random_seed: int = 0
+    stop_words: list[str] = field(default_factory=list)
+    ignore_eos: bool = False          # benchmarking aid
+
+    def __post_init__(self) -> None:
+        if self.beam_width != 1:
+            raise ValueError("beam_width != 1 is not supported")
+        if self.max_tokens < 1:
+            raise ValueError("max_tokens must be >= 1")

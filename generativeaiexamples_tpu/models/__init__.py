@@ -1,0 +1,1 @@
+"""JAX model definitions and checkpoint importers."""

@@ -1,0 +1,110 @@
+"""Model architecture configs and the named-model registry.
+
+Covers the model families the reference serves: Llama-2 chat 7B/13B/70B and
+CodeLlama (reference: model_server/model.py:76-87 ``ModelTypes``
+LLAMA/CODE_LLAMA/GPTNEXT; docs/rag/support_matrix.md sizing), the
+e5-large-v2 embedder (reference: common/configuration.py:95-121), and
+Mixtral-8x7B for expert parallelism (reference uses it via cloud endpoints
+only, examples/5_mins_rag_no_gpu/main.py:50 — here it is first-class).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    """Decoder-only transformer (Llama-2 family geometry)."""
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32
+    head_dim: int = 128
+    max_position_embeddings: int = 4096
+    rope_theta: float = 10000.0
+    rope_scaling_factor: float = 1.0
+    rms_norm_eps: float = 1e-5
+    tie_word_embeddings: bool = False
+    # MoE (Mixtral): 0 experts = dense MLP.
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+
+    @property
+    def q_dim(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.num_kv_heads * self.head_dim
+
+
+@dataclass(frozen=True)
+class EncoderConfig:
+    """BERT-style bidirectional encoder (e5-large-v2 geometry)."""
+    vocab_size: int = 30522
+    hidden_size: int = 1024
+    intermediate_size: int = 4096
+    num_layers: int = 24
+    num_heads: int = 16
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+
+
+# ---------------------------------------------------------------------------
+# Named registry. Names mirror what the reference's chain server configures
+# (reference: deploy/compose/config.yaml model_name entries).
+# ---------------------------------------------------------------------------
+
+LLAMA2_7B = LlamaConfig()
+LLAMA2_13B = LlamaConfig(hidden_size=5120, intermediate_size=13824,
+                         num_layers=40, num_heads=40, num_kv_heads=40)
+LLAMA2_70B = LlamaConfig(hidden_size=8192, intermediate_size=28672,
+                         num_layers=80, num_heads=64, num_kv_heads=8)
+CODELLAMA_13B = replace(LLAMA2_13B, vocab_size=32016, rope_theta=1_000_000.0,
+                        max_position_embeddings=16384)
+MIXTRAL_8X7B = LlamaConfig(hidden_size=4096, intermediate_size=14336,
+                           num_layers=32, num_heads=32, num_kv_heads=8,
+                           rope_theta=1_000_000.0,
+                           max_position_embeddings=32768,
+                           num_experts=8, num_experts_per_tok=2)
+
+# Small geometries for tests/benchmarks on limited hardware.
+LLAMA_TINY = LlamaConfig(vocab_size=512, hidden_size=128, intermediate_size=352,
+                         num_layers=2, num_heads=4, num_kv_heads=2, head_dim=32,
+                         max_position_embeddings=512)
+LLAMA_1B = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                       intermediate_size=5632, num_layers=22,
+                       num_heads=32, num_kv_heads=4, head_dim=64)
+
+E5_LARGE_V2 = EncoderConfig()
+ENCODER_TINY = EncoderConfig(vocab_size=512, hidden_size=64,
+                             intermediate_size=128, num_layers=2, num_heads=4,
+                             max_position_embeddings=128)
+
+MODEL_REGISTRY: dict[str, LlamaConfig] = {
+    "llama-2-7b-chat": LLAMA2_7B,
+    "llama-2-13b-chat": LLAMA2_13B,
+    "llama-2-70b-chat": LLAMA2_70B,
+    "codellama-13b-instruct": CODELLAMA_13B,
+    "mixtral-8x7b-instruct": MIXTRAL_8X7B,
+    "llama-tiny": LLAMA_TINY,
+    "llama-1b": LLAMA_1B,
+}
+
+ENCODER_REGISTRY: dict[str, EncoderConfig] = {
+    "intfloat/e5-large-v2": E5_LARGE_V2,
+    "e5-large-v2": E5_LARGE_V2,
+    "encoder-tiny": ENCODER_TINY,
+}
+
+
+def get_model_config(name: str) -> LlamaConfig:
+    try:
+        return MODEL_REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown model {name!r}; known: {sorted(MODEL_REGISTRY)}") from None

@@ -1,0 +1,191 @@
+"""BERT-style bidirectional encoder (e5-large-v2 family) in functional JAX.
+
+Replaces the reference's torch/CUDA embedding path — HuggingFaceEmbeddings
+pinned to cuda:0 (reference: common/utils.py:270-297) — with a jit batch
+encoder. Same stacked-layers + ``lax.scan`` design as the decoder.
+
+Param tree:
+  embed: word (V,D), pos (P,D), type (T,D), ln_scale (D,), ln_bias (D,)
+  layers (all stacked on leading L):
+    wq/wk/wv/wo (L,D,D), bq/bk/bv/bo (L,D),
+    attn_ln_s/attn_ln_b (L,D),
+    w_in (L,D,F), b_in (L,F), w_out (L,F,D), b_out (L,D),
+    mlp_ln_s/mlp_ln_b (L,D)
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.errors import ModelLoadError
+from .configs import EncoderConfig
+
+Params = dict[str, Any]
+
+
+def _layernorm(x: jax.Array, scale: jax.Array, bias: jax.Array,
+               eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return (y * scale.astype(jnp.float32)
+            + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def init_params(cfg: EncoderConfig, key: jax.Array,
+                dtype: jnp.dtype = jnp.float32) -> Params:
+    ks = iter(jax.random.split(key, 24))
+    D, F, L, V = (cfg.hidden_size, cfg.intermediate_size, cfg.num_layers,
+                  cfg.vocab_size)
+
+    def norm(rng, shape, fan_in):
+        return (jax.random.normal(rng, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(dtype)
+
+    return {
+        "embed": {
+            "word": norm(next(ks), (V, D), D),
+            "pos": norm(next(ks), (cfg.max_position_embeddings, D), D),
+            "type": norm(next(ks), (cfg.type_vocab_size, D), D),
+            "ln_scale": jnp.ones((D,), dtype),
+            "ln_bias": jnp.zeros((D,), dtype),
+        },
+        "layers": {
+            "wq": norm(next(ks), (L, D, D), D), "bq": jnp.zeros((L, D), dtype),
+            "wk": norm(next(ks), (L, D, D), D), "bk": jnp.zeros((L, D), dtype),
+            "wv": norm(next(ks), (L, D, D), D), "bv": jnp.zeros((L, D), dtype),
+            "wo": norm(next(ks), (L, D, D), D), "bo": jnp.zeros((L, D), dtype),
+            "attn_ln_s": jnp.ones((L, D), dtype),
+            "attn_ln_b": jnp.zeros((L, D), dtype),
+            "w_in": norm(next(ks), (L, D, F), D), "b_in": jnp.zeros((L, F), dtype),
+            "w_out": norm(next(ks), (L, F, D), F), "b_out": jnp.zeros((L, D), dtype),
+            "mlp_ln_s": jnp.ones((L, D), dtype),
+            "mlp_ln_b": jnp.zeros((L, D), dtype),
+        },
+    }
+
+
+def apply(params: Params, cfg: EncoderConfig, tokens: jax.Array,
+          attention_mask: jax.Array) -> jax.Array:
+    """Forward pass → last hidden states (B, S, D).
+
+    tokens: (B, S) int32, attention_mask: (B, S) {0,1}.
+    """
+    B, S = tokens.shape
+    H = cfg.num_heads
+    hd = cfg.hidden_size // H
+    eps = cfg.layer_norm_eps
+
+    e = params["embed"]
+    h = (jnp.take(e["word"], tokens, axis=0)
+         + e["pos"][None, :S]
+         + e["type"][0][None, None, :])
+    h = _layernorm(h, e["ln_scale"], e["ln_bias"], eps)
+
+    neg = jnp.asarray(-1e30, jnp.float32)
+    attn_bias = jnp.where(attention_mask[:, None, None, :].astype(bool),
+                          0.0, neg)  # (B,1,1,S)
+
+    def layer(h, lp):
+        q = (h @ lp["wq"] + lp["bq"]).reshape(B, S, H, hd)
+        k = (h @ lp["wk"] + lp["bk"]).reshape(B, S, H, hd)
+        v = (h @ lp["wv"] + lp["bv"]).reshape(B, S, H, hd)
+        scores = jnp.einsum("bshd,bthd->bhst", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) / (hd ** 0.5)
+        probs = jax.nn.softmax(scores + attn_bias, axis=-1).astype(h.dtype)
+        ctx = jnp.einsum("bhst,bthd->bshd", probs, v).reshape(B, S, -1)
+        h = _layernorm(h + (ctx @ lp["wo"] + lp["bo"]), lp["attn_ln_s"],
+                       lp["attn_ln_b"], eps)
+        ffn = jax.nn.gelu(h @ lp["w_in"] + lp["b_in"], approximate=False)
+        h = _layernorm(h + (ffn @ lp["w_out"] + lp["b_out"]), lp["mlp_ln_s"],
+                       lp["mlp_ln_b"], eps)
+        return h, None
+
+    h, _ = jax.lax.scan(layer, h, params["layers"])
+    return h
+
+
+def mean_pool(hidden: jax.Array, attention_mask: jax.Array,
+              normalize: bool = True) -> jax.Array:
+    """Masked mean pooling + optional L2 norm — the e5 recipe."""
+    maskf = attention_mask.astype(jnp.float32)[..., None]
+    summed = jnp.sum(hidden.astype(jnp.float32) * maskf, axis=1)
+    pooled = summed / jnp.maximum(jnp.sum(maskf, axis=1), 1e-9)
+    if normalize:
+        pooled = pooled / jnp.linalg.norm(pooled, axis=-1, keepdims=True)
+    return pooled
+
+
+# --------------------------------------------------------------- HF import
+
+_EMBED_KEYS = {
+    "embeddings.word_embeddings.weight": ("word", False),
+    "embeddings.position_embeddings.weight": ("pos", False),
+    "embeddings.token_type_embeddings.weight": ("type", False),
+    "embeddings.LayerNorm.weight": ("ln_scale", False),
+    "embeddings.LayerNorm.bias": ("ln_bias", False),
+}
+
+_LAYER_KEYS = {
+    "attention.self.query.weight": ("wq", True),
+    "attention.self.query.bias": ("bq", False),
+    "attention.self.key.weight": ("wk", True),
+    "attention.self.key.bias": ("bk", False),
+    "attention.self.value.weight": ("wv", True),
+    "attention.self.value.bias": ("bv", False),
+    "attention.output.dense.weight": ("wo", True),
+    "attention.output.dense.bias": ("bo", False),
+    "attention.output.LayerNorm.weight": ("attn_ln_s", False),
+    "attention.output.LayerNorm.bias": ("attn_ln_b", False),
+    "intermediate.dense.weight": ("w_in", True),
+    "intermediate.dense.bias": ("b_in", False),
+    "output.dense.weight": ("w_out", True),
+    "output.dense.bias": ("b_out", False),
+    "output.LayerNorm.weight": ("mlp_ln_s", False),
+    "output.LayerNorm.bias": ("mlp_ln_b", False),
+}
+
+
+def params_from_named_tensors(tensors: Iterator[tuple[str, Any]],
+                              cfg: EncoderConfig,
+                              dtype: jnp.dtype = jnp.float32) -> Params:
+    """HF BertModel-named tensors → param tree (names with or without the
+    ``bert.`` prefix)."""
+    L = cfg.num_layers
+    embed: dict[str, Any] = {}
+    layer_acc: dict[str, list] = {}
+
+    def to_np(t):
+        if isinstance(t, np.ndarray):
+            return t
+        import torch
+        if isinstance(t, torch.Tensor):
+            return t.detach().to(torch.float32).cpu().numpy()
+        return np.asarray(t)
+
+    for key, raw in tensors:
+        key = key.removeprefix("bert.")
+        if key in _EMBED_KEYS:
+            name, _ = _EMBED_KEYS[key]
+            embed[name] = to_np(raw)
+            continue
+        m = re.match(r"encoder\.layer\.(\d+)\.(.+)$", key)
+        if m and m.group(2) in _LAYER_KEYS:
+            name, transpose = _LAYER_KEYS[m.group(2)]
+            arr = to_np(raw)
+            layer_acc.setdefault(name, [None] * L)[int(m.group(1))] = (
+                arr.T if transpose else arr)
+
+    if len(embed) != 5 or any(x is None for v in layer_acc.values() for x in v):
+        raise ModelLoadError("incomplete encoder checkpoint")
+    return {
+        "embed": {k: jnp.asarray(v, dtype) for k, v in embed.items()},
+        "layers": {k: jnp.asarray(np.stack(v, axis=0), dtype)
+                   for k, v in layer_acc.items()},
+    }

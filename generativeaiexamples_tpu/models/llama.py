@@ -1,0 +1,191 @@
+"""Llama-family decoder in functional JAX.
+
+The TPU-native replacement for the reference's TRT-LLM engine build
+(reference: llm-inference-server/conversion_scripts/llama/build.py) — instead
+of building per-rank TensorRT engines, the model is a pure function of a
+parameter pytree, jit-compiled by XLA and sharded with NamedSharding.
+
+Design choices (TPU-first, not a port):
+- **Stacked layer params + ``lax.scan``**: every per-layer tensor is stacked
+  along a leading L axis and the decoder scans over layers. One layer gets
+  traced/compiled, not 32/40/80 — compile time stays flat with depth, and
+  sharding rules are written once per leaf.
+- **Absolute-position KV cache**: cache index == token position. Prefill and
+  decode are the same function with different (tokens, positions) shapes; no
+  dynamic shapes ever reach XLA.
+- **GQA without KV duplication**: grouped einsum in ``ops.attention`` instead
+  of materializing duplicated KV heads (the reference duplicates weights when
+  tp > n_kv_heads, conversion_scripts/llama/weight.py:150-157).
+- **MoE branch** (Mixtral): dense-compute router mixing here; the
+  expert-parallel shard_map path lives in ``parallel/``.
+
+Param tree (all projections stored input-major so forward is ``x @ W``):
+  embed:       (V, D)
+  layers:
+    attn_norm: (L, D)         mlp_norm: (L, D)
+    wq: (L, D, H*hd)  wk: (L, D, KV*hd)  wv: (L, D, KV*hd)  wo: (L, H*hd, D)
+    w_gate/w_up: (L, D, F)    w_down: (L, F, D)          [dense MLP]
+    router: (L, D, E)  w_gate/w_up: (L, E, D, F)  w_down: (L, E, F, D)  [MoE]
+  final_norm:  (D,)
+  lm_head:     (D, V)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import gqa_attention
+from ..ops.rmsnorm import rmsnorm
+from ..ops.rope import apply_rope, rope_frequencies
+from .configs import LlamaConfig
+
+Params = dict[str, Any]
+KVCache = dict[str, jax.Array]  # {"k": (L,B,T,KV,hd), "v": (L,B,T,KV,hd)}
+
+
+def init_params(cfg: LlamaConfig, key: jax.Array,
+                dtype: jnp.dtype = jnp.bfloat16) -> Params:
+    """Random-init parameter tree (for tests/benchmarks; real weights come
+    from ``import_hf``)."""
+    k = iter(jax.random.split(key, 16))
+    D, F, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    H, KV, hd, V = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim, cfg.vocab_size
+
+    def norm(rng, shape, fan_in):
+        return (jax.random.normal(rng, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(dtype)
+
+    layers: dict[str, jax.Array] = {
+        "attn_norm": jnp.ones((L, D), dtype),
+        "mlp_norm": jnp.ones((L, D), dtype),
+        "wq": norm(next(k), (L, D, H * hd), D),
+        "wk": norm(next(k), (L, D, KV * hd), D),
+        "wv": norm(next(k), (L, D, KV * hd), D),
+        "wo": norm(next(k), (L, H * hd, D), H * hd),
+    }
+    if cfg.num_experts:
+        E = cfg.num_experts
+        layers.update({
+            "router": norm(next(k), (L, D, E), D),
+            "w_gate": norm(next(k), (L, E, D, F), D),
+            "w_up": norm(next(k), (L, E, D, F), D),
+            "w_down": norm(next(k), (L, E, F, D), F),
+        })
+    else:
+        layers.update({
+            "w_gate": norm(next(k), (L, D, F), D),
+            "w_up": norm(next(k), (L, D, F), D),
+            "w_down": norm(next(k), (L, F, D), F),
+        })
+    params: Params = {
+        "embed": norm(next(k), (V, D), D),
+        "layers": layers,
+        "final_norm": jnp.ones((D,), dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = norm(next(k), (D, V), D)
+    return params
+
+
+def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int,
+                  dtype: jnp.dtype = jnp.bfloat16) -> KVCache:
+    shape = (cfg.num_layers, batch, max_len, cfg.num_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def _dense_mlp(x: jax.Array, lp: dict[str, jax.Array]) -> jax.Array:
+    gate = jax.nn.silu(x @ lp["w_gate"])
+    return (gate * (x @ lp["w_up"])) @ lp["w_down"]
+
+
+def _moe_mlp(x: jax.Array, lp: dict[str, jax.Array], cfg: LlamaConfig) -> jax.Array:
+    """Mixtral MLP, dense-compute formulation: every expert runs on every
+    token and the top-k router weights zero out the rest. O(E) FLOPs but
+    fully static — the EP-sharded sparse path is in parallel/moe.py."""
+    B, S, D = x.shape
+    logits = x @ lp["router"]  # (B,S,E)
+    weights, idx = jax.lax.top_k(logits, cfg.num_experts_per_tok)
+    weights = jax.nn.softmax(weights.astype(jnp.float32), axis=-1).astype(x.dtype)
+    # gates: (B,S,E) with softmaxed weights at the top-k positions
+    gates = jnp.zeros_like(logits).at[
+        jnp.arange(B)[:, None, None], jnp.arange(S)[None, :, None], idx
+    ].set(weights)
+    gate = jax.nn.silu(jnp.einsum("bsd,edf->bsef", x, lp["w_gate"]))
+    up = jnp.einsum("bsd,edf->bsef", x, lp["w_up"])
+    down = jnp.einsum("bsef,efd->bsed", gate * up, lp["w_down"])
+    return jnp.einsum("bsed,bse->bsd", down, gates)
+
+
+def apply(params: Params, cfg: LlamaConfig, tokens: jax.Array,
+          positions: jax.Array, kv_cache: Optional[KVCache] = None,
+          kv_valid_len: Optional[jax.Array] = None, *,
+          return_hidden: bool = False,
+          ) -> tuple[jax.Array, Optional[KVCache]]:
+    """Forward pass. Serves prefill, decode, and training with one function.
+
+    tokens:      (B, S) int32
+    positions:   (B, S) int32 absolute positions (row-contiguous).
+    kv_cache:    absolute-position cache; new K/V are written at
+                 ``positions`` and attention reads the whole cache.
+    kv_valid_len:(B,) valid key count per row. Defaults to
+                 ``positions[:, -1] + 1`` when a cache is used, else in-seq
+                 causal masking only.
+    Returns (logits (B,S,V) or hidden (B,S,D), updated cache or None).
+    """
+    B, S = tokens.shape
+    inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta,
+                                cfg.rope_scaling_factor)
+    h = jnp.take(params["embed"], tokens, axis=0)
+    row_start = positions[:, 0]
+    if kv_cache is not None and kv_valid_len is None:
+        kv_valid_len = positions[:, -1] + 1
+
+    def qkv(x: jax.Array, lp: dict[str, jax.Array]):
+        q = (x @ lp["wq"]).reshape(B, S, cfg.num_heads, cfg.head_dim)
+        k = (x @ lp["wk"]).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
+        v = (x @ lp["wv"]).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
+        return apply_rope(q, k, positions, inv_freq) + (v,)
+
+    def finish_layer(h: jax.Array, attn: jax.Array, lp: dict[str, jax.Array]):
+        h = h + attn.reshape(B, S, cfg.q_dim) @ lp["wo"]
+        x = rmsnorm(h, lp["mlp_norm"], cfg.rms_norm_eps)
+        mlp = _moe_mlp(x, lp, cfg) if cfg.num_experts else _dense_mlp(x, lp)
+        return h + mlp
+
+    def layer_cached(h: jax.Array, xs):
+        lp, kc, vc = xs  # kc/vc: (B,T,KV,hd)
+        q, k, v = qkv(rmsnorm(h, lp["attn_norm"], cfg.rms_norm_eps), lp)
+        # Write this chunk at its absolute positions (rows contiguous).
+        kc = jax.vmap(
+            lambda c, u, s: jax.lax.dynamic_update_slice(c, u, (s, 0, 0))
+        )(kc, k, row_start)
+        vc = jax.vmap(
+            lambda c, u, s: jax.lax.dynamic_update_slice(c, u, (s, 0, 0))
+        )(vc, v, row_start)
+        attn = gqa_attention(q, kc, vc, positions, kv_valid_len)
+        return finish_layer(h, attn, lp), (kc, vc)
+
+    def layer_nocache(h: jax.Array, lp):
+        q, k, v = qkv(rmsnorm(h, lp["attn_norm"], cfg.rms_norm_eps), lp)
+        attn = gqa_attention(q, k, v, positions, kv_valid_len)
+        return finish_layer(h, attn, lp), None
+
+    if kv_cache is not None:
+        h, (new_k, new_v) = jax.lax.scan(
+            layer_cached, h, (params["layers"], kv_cache["k"], kv_cache["v"]))
+        new_cache: Optional[KVCache] = {"k": new_k, "v": new_v}
+    else:
+        h, _ = jax.lax.scan(layer_nocache, h, params["layers"])
+        new_cache = None
+
+    h = rmsnorm(h, params["final_norm"], cfg.rms_norm_eps)
+    if return_hidden:
+        return h, new_cache
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    logits = (h.astype(jnp.float32) @ head.astype(jnp.float32))
+    return logits, new_cache

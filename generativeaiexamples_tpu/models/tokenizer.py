@@ -1,0 +1,91 @@
+"""Tokenizers: HF `tokenizers` wrapper + self-contained byte fallback.
+
+The reference tokenizes inside Triton Python models with AutoTokenizer
+(reference: ensemble_models/llama/preprocessing/1/model.py:56-92, pad id
+END_ID=2 at _create_request 167-181) and detokenizes per-token handling
+sentencepiece space/newline sentinels
+(reference: ensemble_models/llama/postprocessing/1/model.py:131-154).
+
+Here tokenization is a host-side service used by the engine and the text
+splitter. ``ByteTokenizer`` needs no vocab files (important for hermetic
+tests and air-gapped TPU pods); ``HFTokenizer`` loads a ``tokenizer.json``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Protocol, Sequence
+
+
+class Tokenizer(Protocol):
+    bos_id: int
+    eos_id: int
+    pad_id: int
+
+    @property
+    def vocab_size(self) -> int: ...
+    def encode(self, text: str, *, add_bos: bool = True) -> list[int]: ...
+    def decode(self, ids: Sequence[int]) -> str: ...
+
+
+class ByteTokenizer:
+    """UTF-8 byte-level tokenizer: ids 0..2 = pad/bos/eos, 3..258 = bytes.
+
+    Id conventions follow the Llama sentencepiece family (pad=0, bos=1,
+    eos=2 — the reference pads with END_ID=2,
+    ensemble_models/llama/preprocessing/1/model.py:167-181).
+    """
+
+    pad_id, bos_id, eos_id = 0, 1, 2
+    _OFFSET = 3
+
+    @property
+    def vocab_size(self) -> int:
+        return 256 + self._OFFSET
+
+    def encode(self, text: str, *, add_bos: bool = True) -> list[int]:
+        ids = [b + self._OFFSET for b in text.encode("utf-8")]
+        return ([self.bos_id] + ids) if add_bos else ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        data = bytes(i - self._OFFSET for i in ids
+                     if i >= self._OFFSET and i < self._OFFSET + 256)
+        return data.decode("utf-8", errors="replace")
+
+
+class HFTokenizer:
+    """Wraps a ``tokenizers.Tokenizer`` loaded from tokenizer.json."""
+
+    def __init__(self, path: str):
+        from tokenizers import Tokenizer as _Tok
+        if os.path.isdir(path):
+            path = os.path.join(path, "tokenizer.json")
+        self._tok = _Tok.from_file(path)
+        self.pad_id = self._special_id(("<pad>", "[PAD]", "<unk>"), 0)
+        self.bos_id = self._special_id(("<s>", "[CLS]", "<|begin_of_text|>"), 1)
+        self.eos_id = self._special_id(("</s>", "[SEP]", "<|end_of_text|>"), 2)
+
+    def _special_id(self, candidates: tuple[str, ...], default: int) -> int:
+        for tok in candidates:
+            tid = self._tok.token_to_id(tok)
+            if tid is not None:
+                return tid
+        return default
+
+    @property
+    def vocab_size(self) -> int:
+        return self._tok.get_vocab_size()
+
+    def encode(self, text: str, *, add_bos: bool = True) -> list[int]:
+        ids = self._tok.encode(text, add_special_tokens=False).ids
+        return ([self.bos_id] + ids) if add_bos else ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._tok.decode(list(ids), skip_special_tokens=True)
+
+
+def get_tokenizer(spec: str = "byte") -> Tokenizer:
+    """Factory: 'byte' or a path to a tokenizer.json / HF model dir."""
+    if spec == "byte":
+        return ByteTokenizer()
+    return HFTokenizer(spec)

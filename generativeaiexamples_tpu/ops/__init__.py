@@ -1,0 +1,8 @@
+"""TPU compute primitives.
+
+The framework's answer to the reference's TensorRT-LLM plugin set
+(reference: llm-inference-server/conversion_scripts/llama/build.py:624-656 —
+GPT-attention / GEMM / RMSNorm plugins, paged KV, NCCL): here each op is a
+jnp reference implementation plus, where it matters, a Pallas TPU kernel.
+XLA fuses the rest.
+"""

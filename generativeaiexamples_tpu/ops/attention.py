@@ -1,0 +1,61 @@
+"""Attention: GQA with absolute-position causal masking.
+
+Replaces the reference's TRT GPT-attention plugin (reference:
+conversion_scripts/llama/build.py:624-628 ``set_gpt_attention_plugin`` with
+paged KV + remove-input-padding). The jnp implementation here is the
+reference semantics; the Pallas flash/paged kernels in ``flash_attention.py``
+/ ``paged_attention.py`` are drop-in replacements for the hot paths.
+
+Layout conventions (chosen for TPU tiling — head_dim last, 128-aligned):
+  q:        (B, S, H,  hd)
+  k, v:     (B, T, KV, hd)      T = key length (cache capacity)
+  output:   (B, S, H,  hd)
+GQA: H = KV * G. We reshape q to (B, S, KV, G, hd) and batch the KV heads —
+the XLA analogue of the reference's KV-head duplication trick
+(reference: conversion_scripts/llama/weight.py:150-157 ``dup_kv_weight``),
+but without materializing duplicated KV.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30  # large-negative instead of -inf: avoids NaN from 0*inf
+
+
+def gqa_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                  q_positions: jax.Array, kv_valid_len: jax.Array | None = None,
+                  *, causal: bool = True) -> jax.Array:
+    """Grouped-query attention over an absolute-position KV buffer.
+
+    q_positions: (B, S) int32 — absolute position of each query token.
+    kv_valid_len: (B,) int32 — number of valid keys per row (rest is padding
+        in a fixed-capacity cache). None = all T keys valid.
+    causal: query at position p attends keys at cache indices <= p. The KV
+        buffer is indexed by absolute position (index i holds the token at
+        position i), which is what the slotted cache guarantees.
+    """
+    B, S, H, hd = q.shape
+    _, T, KV, _ = k.shape
+    G = H // KV
+    scale = 1.0 / (hd ** 0.5)
+
+    qf = q.astype(jnp.float32).reshape(B, S, KV, G, hd)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+
+    # scores: (B, KV, G, S, T)
+    scores = jnp.einsum("bskgh,btkh->bkgst", qf, kf) * scale
+
+    key_idx = jnp.arange(T, dtype=jnp.int32)
+    mask = jnp.ones((B, S, T), dtype=bool)
+    if causal:
+        mask = key_idx[None, None, :] <= q_positions[:, :, None]
+    if kv_valid_len is not None:
+        mask = mask & (key_idx[None, None, :] < kv_valid_len[:, None, None])
+    scores = jnp.where(mask[:, None, None, :, :], scores, NEG_INF)
+
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgst,btkh->bskgh", probs, vf)
+    return out.reshape(B, S, H, hd).astype(q.dtype)

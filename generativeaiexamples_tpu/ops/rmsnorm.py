@@ -1,0 +1,25 @@
+"""RMSNorm.
+
+Replaces the reference's TRT RMSNorm plugin
+(reference: conversion_scripts/llama/build.py:630 ``set_rmsnorm_plugin``).
+A plain jnp expression — XLA fuses it into neighboring ops on TPU, so no
+Pallas kernel is needed for this one.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rmsnorm(x: jax.Array, weight: jax.Array, eps: float = 1e-5) -> jax.Array:
+    """y = x / rms(x) * weight, computed in f32 for stability.
+
+    Matches HF LlamaRMSNorm semantics: variance in float32, scale applied
+    in the input dtype.
+    """
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    y = xf * jax.lax.rsqrt(var + eps)
+    return (y.astype(dtype) * weight.astype(dtype)).astype(dtype)

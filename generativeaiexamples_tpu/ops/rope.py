@@ -1,0 +1,48 @@
+"""Rotary position embeddings (RoPE), HF ``rotate_half`` convention.
+
+The reference bakes RoPE into its TRT GPT-attention plugin with optional
+linear/dynamic scaling (reference: conversion_scripts/llama/build.py:399-408
+``rotary_scaling``). Here it is a pure function of absolute positions so the
+same code serves full-sequence prefill and single-token decode (positions are
+just different), which is what XLA wants: no data-dependent shapes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, theta: float = 10000.0,
+                     scaling_factor: float = 1.0) -> jax.Array:
+    """Inverse frequencies, shape (head_dim // 2,), float32.
+
+    ``scaling_factor > 1`` implements "linear" RoPE scaling (positions are
+    divided by the factor), parity with the reference's
+    ``rotary_scaling type=linear`` flag (build.py:399-408).
+    """
+    exponents = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+    inv_freq = 1.0 / (theta ** exponents)
+    return inv_freq / scaling_factor
+
+
+def apply_rope(q: jax.Array, k: jax.Array, positions: jax.Array,
+               inv_freq: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Rotate q and k by position-dependent angles.
+
+    q: (..., S, H, hd), k: (..., S, KV, hd), positions: (..., S) int32.
+    Uses the HF non-interleaved layout: the head dim is split into two
+    halves and rotated as (x1, x2) -> (x1*cos - x2*sin, x2*cos + x1*sin),
+    matching transformers' ``rotate_half`` so HF-imported weights are
+    bit-compatible.
+    """
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # (..., S, hd/2)
+    cos = jnp.cos(angles)[..., None, :]  # (..., S, 1, hd/2)
+    sin = jnp.sin(angles)[..., None, :]
+
+    def rot(x: jax.Array) -> jax.Array:
+        x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+        out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+        return out.astype(x.dtype)
+
+    return rot(q), rot(k)

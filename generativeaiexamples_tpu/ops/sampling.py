@@ -1,0 +1,86 @@
+"""On-device token sampling: temperature / top-k / top-p / greedy.
+
+Replaces the sampling config the reference passes as Triton tensors into the
+TRT-LLM backend (reference: ensemble_models/llama/ensemble/config.pbtxt:27-117
+``top_k``/``top_p``/``temperature``/``random_seed``; client defaults temp 1.0,
+top_k 1, top_p 0 in model_server_client/trt_llm.py:68-74).
+
+Everything is batched and static-shape: per-request knobs are vectors, the
+"is greedy" decision is a ``where``, and top-k works for any k via a sort +
+rank mask (no data-dependent shapes under jit).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def sample(logits: jax.Array, key: jax.Array, temperature: jax.Array,
+           top_k: jax.Array, top_p: jax.Array) -> jax.Array:
+    """Sample next tokens.
+
+    logits:      (B, V) float
+    temperature: (B,) — <= 0 means greedy
+    top_k:       (B,) int — <= 0 means unlimited
+    top_p:       (B,) float — <= 0 or >= 1 means unlimited
+    Returns (B,) int32 token ids.
+    """
+    B, V = logits.shape
+    lf = logits.astype(jnp.float32)
+    greedy_ids = jnp.argmax(lf, axis=-1).astype(jnp.int32)
+
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = lf / temp
+
+    # Rank of each vocab entry (0 = best) via descending sort.
+    sort_idx = jnp.argsort(-scaled, axis=-1)                     # (B, V)
+    ranks = jnp.zeros_like(sort_idx).at[
+        jnp.arange(B)[:, None], sort_idx
+    ].set(jnp.broadcast_to(jnp.arange(V), (B, V)))
+
+    k = jnp.where(top_k[:, None] <= 0, V, top_k[:, None])
+    keep = ranks < k
+
+    # top-p: keep the smallest prefix of sorted probs with cumsum >= p.
+    sorted_logits = jnp.take_along_axis(scaled, sort_idx, axis=-1)
+    sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(sorted_probs, axis=-1)
+    p = jnp.where((top_p[:, None] <= 0) | (top_p[:, None] >= 1.0),
+                  1.0, top_p[:, None])
+    # token at sorted position j survives if the cumulative mass *before* it
+    # is < p (so the first token always survives).
+    sorted_keep_p = (cum - sorted_probs) < p
+    keep_p = jnp.zeros_like(keep).at[
+        jnp.arange(B)[:, None], sort_idx
+    ].set(sorted_keep_p)
+
+    masked = jnp.where(keep & keep_p, scaled, NEG_INF)
+    sampled = jax.random.categorical(key, masked, axis=-1).astype(jnp.int32)
+
+    is_greedy = (temperature <= 0) | (top_k == 1)
+    return jnp.where(is_greedy, greedy_ids, sampled)
+
+
+def apply_repetition_penalty(logits: jax.Array, token_history: jax.Array,
+                             valid_len: jax.Array,
+                             penalty: jax.Array) -> jax.Array:
+    """CTRL-style repetition penalty over each row's token history.
+
+    token_history: (B, T) int32 (cache-resident prompt+generated ids),
+    valid_len: (B,), penalty: (B,) — 1.0 is a no-op.
+    Parity with the reference's ``repetition_penalty`` ensemble tensor
+    (ensemble/config.pbtxt).
+    """
+    B, V = logits.shape
+    T = token_history.shape[1]
+    pos_valid = jnp.arange(T)[None, :] < valid_len[:, None]
+    seen = jnp.zeros((B, V), bool).at[
+        jnp.arange(B)[:, None], token_history
+    ].max(pos_valid)
+    pen = penalty[:, None]
+    lf = logits.astype(jnp.float32)
+    penalized = jnp.where(lf > 0, lf / pen, lf * pen)
+    return jnp.where(seen, penalized, lf).astype(logits.dtype)

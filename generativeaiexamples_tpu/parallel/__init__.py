@@ -1,0 +1,16 @@
+"""Device-mesh construction and sharding rules.
+
+The XLA-collectives answer to the reference's NCCL/MPI stack: where the
+reference launches one Triton process per GPU rank under mpirun and lets
+TRT engines all-reduce through NCCL
+(reference: model_server/server.py:78-101, conversion_scripts/llama/
+build.py:651-652), here a single jit-compiled program spans the whole mesh
+and XLA emits the collectives over ICI (DCN across hosts).
+"""
+
+from .mesh import AXES, MeshPlan, make_mesh
+from .sharding import (llama_param_specs, shard_params, kv_cache_spec,
+                       activation_spec)
+
+__all__ = ["AXES", "MeshPlan", "make_mesh", "llama_param_specs",
+           "shard_params", "kv_cache_spec", "activation_spec"]

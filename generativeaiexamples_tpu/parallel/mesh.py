@@ -1,0 +1,63 @@
+"""Device-mesh construction.
+
+Replaces the reference's world-size arithmetic and TP×PP==world assertion
+(reference: model_server/__init__.py:103-110; GPU discovery via nvidia-smi in
+model_server/model.py:111-138) with a ``jax.sharding.Mesh``. Axis order puts
+``tp`` innermost so tensor-parallel collectives ride adjacent-chip ICI links;
+``dp`` is outermost (crosses DCN first on multi-host topologies).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from ..utils.errors import ShardingError
+
+# Canonical mesh axes: data, pipeline, expert, sequence, tensor.
+AXES = ("dp", "pp", "ep", "sp", "tp")
+
+
+@dataclass(frozen=True)
+class MeshPlan:
+    """Requested parallelism degrees. 0 ⇒ infer from device count."""
+    dp: int = 1
+    pp: int = 1
+    ep: int = 1
+    sp: int = 1
+    tp: int = 0
+
+    def resolve(self, n_devices: int) -> "MeshPlan":
+        plan = self
+        if plan.tp == 0:
+            fixed = plan.dp * plan.pp * plan.ep * plan.sp
+            if n_devices % fixed:
+                raise ShardingError(
+                    f"{n_devices} devices not divisible by dp*pp*ep*sp={fixed}")
+            plan = MeshPlan(plan.dp, plan.pp, plan.ep, plan.sp,
+                            n_devices // fixed)
+        total = plan.dp * plan.pp * plan.ep * plan.sp * plan.tp
+        if total != n_devices:
+            raise ShardingError(
+                f"dp*pp*ep*sp*tp = {total} != {n_devices} devices "
+                "(the TP·PP=world check of the reference, "
+                "model_server/__init__.py:103-110, generalized)")
+        return plan
+
+
+def make_mesh(plan: MeshPlan | None = None,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build the device mesh.
+
+    Uses all local devices by default. Device ordering follows
+    ``jax.devices()`` which on TPU enumerates chips in torus-adjacent order,
+    so the innermost (tp) axis lands on neighboring chips.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    plan = (plan or MeshPlan()).resolve(len(devices))
+    arr = np.array(devices).reshape(plan.dp, plan.pp, plan.ep, plan.sp, plan.tp)
+    return Mesh(arr, AXES)

@@ -1,0 +1,103 @@
+"""Sharding rules for the model param trees (megatron-style TP).
+
+Replaces the reference's per-rank weight splitting
+(reference: conversion_scripts/llama/weight.py:141-148 ``split`` slices each
+tensor per MPI rank at import time). Here the full logical tree is annotated
+with ``PartitionSpec``s and ``jax.device_put`` / GSPMD does the physical
+placement — one code path for any mesh shape.
+
+Rules (leading axis of every layer tensor is L, sharded over ``pp`` when
+pipeline parallelism is on):
+  wq/wk/wv  (L, D, heads*hd)  → column-parallel: shard out dim over tp
+  wo        (L, heads*hd, D)  → row-parallel: shard in dim over tp
+  w_gate/up (L, D, F)         → column-parallel
+  w_down    (L, F, D)         → row-parallel
+  embed     (V, D)            → shard V over tp (vocab-parallel)
+  lm_head   (D, V)            → shard V over tp
+  MoE experts (L, E, ...)     → shard E over ep, then tp on the inner dims
+XLA inserts the all-reduce after row-parallel matmuls — the compiled
+equivalent of the reference's NCCL all-reduce plugin
+(reference: build.py:341-345 ``use_custom_all_reduce``).
+
+GQA note: when tp > num_kv_heads the reference duplicates KV weights
+(weight.py:150-157). Here ``kv_tp_axis`` degrades wk/wv to replicated in
+that case and XLA re-partitions the attention einsum itself.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.configs import LlamaConfig
+
+Specs = dict[str, Any]
+
+
+def _axis_on(mesh: Mesh, name: str) -> Optional[str]:
+    """Axis name if it exists in the mesh with size > 1, else None."""
+    return name if mesh.shape.get(name, 1) > 1 else None
+
+
+def llama_param_specs(cfg: LlamaConfig, mesh: Mesh) -> Specs:
+    tp = _axis_on(mesh, "tp")
+    pp = _axis_on(mesh, "pp")
+    ep = _axis_on(mesh, "ep")
+    # KV projections can only shard over tp if heads divide evenly.
+    kv_tp = tp if tp and cfg.num_kv_heads % mesh.shape["tp"] == 0 else None
+    q_tp = tp if tp and cfg.num_heads % mesh.shape["tp"] == 0 else None
+
+    layers: Specs = {
+        "attn_norm": P(pp, None),
+        "mlp_norm": P(pp, None),
+        "wq": P(pp, None, q_tp),
+        "wk": P(pp, None, kv_tp),
+        "wv": P(pp, None, kv_tp),
+        "wo": P(pp, q_tp, None),
+    }
+    if cfg.num_experts:
+        layers.update({
+            "router": P(pp, None, None),
+            "w_gate": P(pp, ep, None, tp),
+            "w_up": P(pp, ep, None, tp),
+            "w_down": P(pp, ep, tp, None),
+        })
+    else:
+        layers.update({
+            "w_gate": P(pp, None, tp),
+            "w_up": P(pp, None, tp),
+            "w_down": P(pp, tp, None),
+        })
+    specs: Specs = {
+        "embed": P(tp, None),
+        "layers": layers,
+        "final_norm": P(None),
+    }
+    if not cfg.tie_word_embeddings:
+        specs["lm_head"] = P(None, tp)
+    return specs
+
+
+def kv_cache_spec(cfg: LlamaConfig, mesh: Mesh) -> Specs:
+    """Cache (L, B, T, KV, hd): batch over dp, KV heads over tp."""
+    tp = _axis_on(mesh, "tp")
+    dp = _axis_on(mesh, "dp")
+    pp = _axis_on(mesh, "pp")
+    kv_tp = tp if tp and cfg.num_kv_heads % mesh.shape["tp"] == 0 else None
+    spec = P(pp, dp, None, kv_tp, None)
+    return {"k": spec, "v": spec}
+
+
+def activation_spec(mesh: Mesh) -> P:
+    """Token/hidden activations: batch over dp, replicated over tp."""
+    return P(_axis_on(mesh, "dp"), None)
+
+
+def shard_params(params: Any, mesh: Mesh, specs: Any) -> Any:
+    """Place a param tree onto the mesh per its specs."""
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params, specs,
+        is_leaf=lambda x: not isinstance(x, dict))

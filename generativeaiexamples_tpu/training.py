@@ -1,0 +1,54 @@
+"""Training step for fine-tuning workflows.
+
+The reference ships model-customization recipes (LoRA/SFT notebooks for
+Gemma via NeMo, reference: models/Gemma/lora.ipynb, sft.ipynb) but no
+in-repo training loop. Here fine-tuning is first-class: a jit-compilable
+train step over any mesh (dp/tp/pp/ep shardings), used both by the
+fine-tuning tools and by the multi-chip dry-run validation.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from .models import llama
+from .models.configs import LlamaConfig
+
+
+def cross_entropy_loss(logits: jax.Array, targets: jax.Array,
+                       mask: jax.Array) -> jax.Array:
+    """Masked mean token cross-entropy. logits (B,S,V), targets/mask (B,S)."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    maskf = mask.astype(jnp.float32)
+    return jnp.sum(nll * maskf) / jnp.maximum(jnp.sum(maskf), 1.0)
+
+
+def make_train_step(cfg: LlamaConfig, optimizer: optax.GradientTransformation):
+    """Build a (params, opt_state, batch) -> (params, opt_state, loss) step.
+
+    ``batch`` = {"tokens": (B,S), "targets": (B,S), "mask": (B,S)}.
+    jit it with shardings from ``parallel.llama_param_specs`` to train over
+    a mesh; XLA inserts the gradient all-reduces over dp and the TP
+    collectives over tp.
+    """
+
+    def loss_fn(params: llama.Params, batch: dict[str, jax.Array]) -> jax.Array:
+        B, S = batch["tokens"].shape
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+        logits, _ = llama.apply(params, cfg, batch["tokens"], positions,
+                                kv_valid_len=jnp.sum(batch["mask"], axis=-1))
+        return cross_entropy_loss(logits, batch["targets"], batch["mask"])
+
+    def train_step(params: llama.Params, opt_state: Any,
+                   batch: dict[str, jax.Array]):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return train_step

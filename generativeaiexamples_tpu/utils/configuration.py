@@ -120,6 +120,10 @@ def from_dict(cls: Type[_T], data: Mapping[str, Any], *,
 
         if is_dataclass(_unwrap_optional(typ)):
             sub_cls = _unwrap_optional(typ)
+            if present and raw is None:
+                # An empty YAML section header ("llm:") parses to None;
+                # treat it as "use defaults", not an error.
+                present, raw = False, dataclasses.MISSING
             if present and not isinstance(raw, Mapping):
                 raise ConfigError(
                     f"config section {'.'.join(path)} must be a mapping, "
@@ -131,6 +135,10 @@ def from_dict(cls: Type[_T], data: Mapping[str, Any], *,
         env_name = _env_var_name(_prefix, path)
         if f.metadata.get("env", True) and env_name in os.environ:
             raw, present = os.environ[env_name], True
+        if present and raw is None and _unwrap_optional(typ) is typ:
+            # Explicit YAML null on a non-Optional field means "unset":
+            # fall through to the schema default rather than str(None).
+            present = False
         if not present:
             if f.default is not dataclasses.MISSING:
                 kwargs[f.name] = f.default

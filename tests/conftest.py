@@ -12,13 +12,23 @@ Must set env BEFORE jax is imported anywhere.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+# Force CPU: the ambient env pins JAX_PLATFORMS to the real TPU backend
+# (and a sitecustomize re-registers it), so the env var alone is not enough —
+# jax.config must be updated post-import, before any backend is initialized.
+# Tests need the 8-device virtual CPU mesh (and fp32 determinism).
+os.environ["JAX_PLATFORMS"] = "cpu"
+import re as _re  # noqa: E402
+
+_flags = os.environ.get("XLA_FLAGS", "")
+_flags = _re.sub(r"--xla_force_host_platform_device_count=\d+", "", _flags)
+os.environ["XLA_FLAGS"] = (
+    _flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

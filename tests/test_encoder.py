@@ -1,0 +1,91 @@
+"""Encoder golden tests vs transformers BertModel + embedding service."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from generativeaiexamples_tpu.embed import HashEmbedder, get_embedder
+from generativeaiexamples_tpu.models import encoder as enc
+from generativeaiexamples_tpu.models.configs import ENCODER_TINY
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+
+@pytest.fixture(scope="module")
+def hf_bert_and_params():
+    hf_cfg = transformers.BertConfig(
+        vocab_size=ENCODER_TINY.vocab_size,
+        hidden_size=ENCODER_TINY.hidden_size,
+        intermediate_size=ENCODER_TINY.intermediate_size,
+        num_hidden_layers=ENCODER_TINY.num_layers,
+        num_attention_heads=ENCODER_TINY.num_heads,
+        max_position_embeddings=ENCODER_TINY.max_position_embeddings,
+        type_vocab_size=ENCODER_TINY.type_vocab_size,
+        layer_norm_eps=ENCODER_TINY.layer_norm_eps,
+        hidden_act="gelu",
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    model = transformers.BertModel(hf_cfg).eval()
+    params = enc.params_from_named_tensors(iter(model.state_dict().items()),
+                                           ENCODER_TINY)
+    return model, params
+
+
+def test_encoder_matches_hf(hf_bert_and_params):
+    model, params = hf_bert_and_params
+    rng = np.random.default_rng(0)
+    B, S = 2, 12
+    tokens = rng.integers(0, ENCODER_TINY.vocab_size, (B, S), dtype=np.int32)
+    mask = np.ones((B, S), np.int32)
+    mask[1, 8:] = 0
+
+    ours = enc.apply(params, ENCODER_TINY, jnp.asarray(tokens),
+                     jnp.asarray(mask))
+    with torch.no_grad():
+        theirs = model(torch.from_numpy(tokens).long(),
+                       attention_mask=torch.from_numpy(mask).long()
+                       ).last_hidden_state.numpy()
+    # Positions under the mask are free to differ; compare valid ones.
+    np.testing.assert_allclose(np.asarray(ours)[0], theirs[0],
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(ours)[1, :8], theirs[1, :8],
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_mean_pool_masked():
+    hidden = jnp.asarray(np.arange(24, dtype=np.float32).reshape(1, 4, 6))
+    mask = jnp.asarray([[1, 1, 0, 0]])
+    pooled = enc.mean_pool(hidden, mask, normalize=False)
+    np.testing.assert_allclose(np.asarray(pooled)[0],
+                               np.arange(24).reshape(4, 6)[:2].mean(0))
+
+
+def test_embedding_service_roundtrip():
+    svc = get_embedder("tpu-jax", "encoder-tiny")
+    docs = svc.embed_documents(["the cat sat", "quantum computing"])
+    q = svc.embed_query("a cat was sitting")
+    assert docs.shape == (2, ENCODER_TINY.hidden_size)
+    assert q.shape == (ENCODER_TINY.hidden_size,)
+    # normalized
+    np.testing.assert_allclose(np.linalg.norm(docs, axis=-1), 1.0, rtol=1e-4)
+
+
+def test_embedding_batch_padding_invariance():
+    """Embedding a text alone vs inside a batch must agree (mask/bucket
+    correctness)."""
+    svc = get_embedder("tpu-jax", "encoder-tiny")
+    alone = svc.embed_documents(["hello world"])[0]
+    batched = svc.embed_documents(["hello world", "x", "yy", "zzz"])[0]
+    np.testing.assert_allclose(alone, batched, rtol=1e-4, atol=1e-5)
+
+
+def test_hash_embedder_similarity():
+    emb = HashEmbedder(dim=128)
+    a = emb.embed_query("retrieval augmented generation")
+    b = emb.embed_query("retrieval augmented generation!")
+    c = emb.embed_query("completely different topic")
+    assert a @ b > a @ c

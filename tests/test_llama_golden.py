@@ -1,0 +1,149 @@
+"""Golden-parity tests: JAX Llama vs transformers on CPU.
+
+The reference has no engine-correctness tests at all (SURVEY.md §4); its
+parity story is manual smoke tests. Here every model change is gated on
+logit parity with the HF implementation.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from generativeaiexamples_tpu.models import llama
+from generativeaiexamples_tpu.models.configs import LLAMA_TINY, LlamaConfig
+from generativeaiexamples_tpu.models.import_hf import params_from_hf_model
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+
+@pytest.fixture(scope="module")
+def hf_model_and_params():
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=LLAMA_TINY.vocab_size,
+        hidden_size=LLAMA_TINY.hidden_size,
+        intermediate_size=LLAMA_TINY.intermediate_size,
+        num_hidden_layers=LLAMA_TINY.num_layers,
+        num_attention_heads=LLAMA_TINY.num_heads,
+        num_key_value_heads=LLAMA_TINY.num_kv_heads,
+        max_position_embeddings=LLAMA_TINY.max_position_embeddings,
+        rms_norm_eps=LLAMA_TINY.rms_norm_eps,
+        rope_theta=LLAMA_TINY.rope_theta,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    model = transformers.LlamaForCausalLM(hf_cfg).eval()
+    params = params_from_hf_model(model, LLAMA_TINY, dtype=jnp.float32)
+    return model, params
+
+
+def hf_logits(model, tokens: np.ndarray) -> np.ndarray:
+    with torch.no_grad():
+        out = model(torch.from_numpy(tokens).long())
+    return out.logits.float().numpy()
+
+
+def test_forward_matches_hf(hf_model_and_params):
+    model, params = hf_model_and_params
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, LLAMA_TINY.vocab_size, size=(2, 17), dtype=np.int32)
+    positions = np.broadcast_to(np.arange(17, dtype=np.int32), (2, 17))
+
+    ours, _ = llama.apply(params, LLAMA_TINY, jnp.asarray(tokens),
+                          jnp.asarray(positions))
+    theirs = hf_logits(model, tokens)
+    np.testing.assert_allclose(np.asarray(ours), theirs, rtol=2e-4, atol=2e-4)
+
+
+def test_gqa_grouping_is_nontrivial():
+    # LLAMA_TINY must actually exercise GQA (H != KV) for the golden test
+    # to cover the grouped path.
+    assert LLAMA_TINY.num_heads != LLAMA_TINY.num_kv_heads
+
+
+def test_kv_cache_decode_matches_full_forward(hf_model_and_params):
+    """Prefill+decode through the cache must equal the full forward."""
+    _, params = hf_model_and_params
+    cfg = LLAMA_TINY
+    rng = np.random.default_rng(1)
+    B, S_total, S_prefill = 2, 12, 8
+    tokens = rng.integers(0, cfg.vocab_size, size=(B, S_total), dtype=np.int32)
+    all_pos = np.broadcast_to(np.arange(S_total, dtype=np.int32), (B, S_total))
+
+    full_logits, _ = llama.apply(params, cfg, jnp.asarray(tokens),
+                                 jnp.asarray(all_pos))
+
+    cache = llama.init_kv_cache(cfg, B, max_len=32, dtype=jnp.float32)
+    pre_logits, cache = llama.apply(
+        params, cfg, jnp.asarray(tokens[:, :S_prefill]),
+        jnp.asarray(all_pos[:, :S_prefill]), cache)
+    np.testing.assert_allclose(np.asarray(pre_logits),
+                               np.asarray(full_logits[:, :S_prefill]),
+                               rtol=1e-4, atol=1e-4)
+
+    for t in range(S_prefill, S_total):
+        step_logits, cache = llama.apply(
+            params, cfg, jnp.asarray(tokens[:, t:t + 1]),
+            jnp.asarray(all_pos[:, t:t + 1]), cache)
+        np.testing.assert_allclose(np.asarray(step_logits[:, 0]),
+                                   np.asarray(full_logits[:, t]),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_ragged_batch_padding_invariance(hf_model_and_params):
+    """A short row padded inside a longer batch must produce the same
+    logits as the same row alone (mask correctness)."""
+    _, params = hf_model_and_params
+    cfg = LLAMA_TINY
+    rng = np.random.default_rng(2)
+    short = rng.integers(0, cfg.vocab_size, size=(1, 5), dtype=np.int32)
+    long_ = rng.integers(0, cfg.vocab_size, size=(1, 9), dtype=np.int32)
+
+    pos5 = np.arange(5, dtype=np.int32)[None]
+    alone, _ = llama.apply(params, cfg, jnp.asarray(short), jnp.asarray(pos5),
+                           kv_valid_len=jnp.asarray([5]))
+
+    batch = np.zeros((2, 9), dtype=np.int32)
+    batch[0, :5] = short[0]
+    batch[1] = long_[0]
+    pos9 = np.broadcast_to(np.arange(9, dtype=np.int32), (2, 9))
+    batched, _ = llama.apply(params, cfg, jnp.asarray(batch),
+                             jnp.asarray(pos9),
+                             kv_valid_len=jnp.asarray([5, 9]))
+    np.testing.assert_allclose(np.asarray(batched[0, :5]),
+                               np.asarray(alone[0]), rtol=1e-4, atol=1e-4)
+
+
+def test_jit_compiles_once_for_decode(hf_model_and_params):
+    _, params = hf_model_and_params
+    cfg = LLAMA_TINY
+    cache = llama.init_kv_cache(cfg, 2, max_len=32, dtype=jnp.float32)
+
+    calls = {"n": 0}
+
+    @jax.jit
+    def step(params, tokens, positions, cache):
+        calls["n"] += 1
+        return llama.apply(params, cfg, tokens, positions, cache)
+
+    toks = jnp.zeros((2, 1), jnp.int32)
+    for t in range(3):
+        pos = jnp.full((2, 1), t, jnp.int32)
+        _, cache = step(params, toks, pos, cache)
+    assert calls["n"] == 1  # traced exactly once
+
+
+def test_moe_forward_runs():
+    """Mixtral-geometry MoE forward produces finite logits (EP parity comes
+    in parallel/)."""
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                      num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+                      num_experts=4, num_experts_per_tok=2)
+    params = llama.init_params(cfg, jax.random.key(0), dtype=jnp.float32)
+    tokens = jnp.zeros((1, 7), jnp.int32)
+    pos = jnp.arange(7, dtype=jnp.int32)[None]
+    logits, _ = llama.apply(params, cfg, tokens, pos)
+    assert logits.shape == (1, 7, 128)
+    assert bool(jnp.isfinite(logits).all())
