@@ -132,26 +132,24 @@ def get_embedder(model_engine: str = "tpu-jax",
     if model_engine == "hash":
         return HashEmbedder(dim=dim)
     if model_engine == "tpu-jax":
+        import os
+
         import jax
 
         from ..models import encoder as enc
+        from ..utils.errors import ConfigError
 
-        cfg = ENCODER_REGISTRY.get(model_name, ENCODER_REGISTRY["encoder-tiny"])
+        if model_name not in ENCODER_REGISTRY:
+            raise ConfigError(
+                f"unknown encoder model {model_name!r}; known: "
+                f"{sorted(ENCODER_REGISTRY)}")
+        cfg = ENCODER_REGISTRY[model_name]
         if checkpoint_path:
-            from safetensors import safe_open
-            import os
-            path = checkpoint_path
-            if os.path.isdir(path):
-                import glob
-                files = glob.glob(os.path.join(path, "*.safetensors"))
-                def gen():
-                    for f in files:
-                        with safe_open(f, framework="np") as fh:
-                            for k in fh.keys():
-                                yield k, fh.get_tensor(k)
-                params = enc.params_from_named_tensors(gen(), cfg)
-            else:
-                raise ValueError("checkpoint_path must be a directory")
+            if not os.path.isdir(checkpoint_path):
+                raise ConfigError("checkpoint_path must be a directory")
+            from ..models.import_hf import _iter_safetensors
+            params = enc.params_from_named_tensors(
+                _iter_safetensors(checkpoint_path), cfg)
             tok = get_tokenizer(checkpoint_path)
         else:
             params = enc.init_params(cfg, jax.random.key(0))

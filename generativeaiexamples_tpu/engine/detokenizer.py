@@ -3,9 +3,10 @@
 Parity with the reference's per-token Python detokenizer model
 (reference: ensemble_models/llama/postprocessing/1/model.py:131-154 —
 ``_id_to_token`` handles sentencepiece SPACE/NEWLINE sentinel chars), done
-robustly: decode the full id sequence each step and emit the stable prefix
-diff, holding back trailing bytes that are still an incomplete UTF-8 /
-sentencepiece fragment.
+robustly with bounded work per token: decode a sliding window of recent ids
+and emit the stable prefix diff, holding back trailing bytes that are still
+an incomplete UTF-8 / sentencepiece fragment. (Decoding the full history
+every step would be O(n²) on the engine's single scheduler thread.)
 """
 
 from __future__ import annotations
@@ -19,31 +20,41 @@ class IncrementalDetokenizer:
     def __init__(self, tokenizer: Tokenizer):
         self._tok = tokenizer
         self._ids: list[int] = []
-        self._emitted = 0  # chars already yielded
+        # Window [prefix:] is what gets re-decoded each step; once a chunk is
+        # emitted the window start advances to the last emitted boundary, so
+        # per-token decode cost stays bounded by the hold-back span.
+        self._prefix = 0        # ids before this index are fully emitted
+        self._read = 0          # ids in [prefix:read] produced emitted text
+        self._text = ""         # everything emitted so far
 
     def push(self, token_id: int) -> str:
         self._ids.append(token_id)
-        text = self._tok.decode(self._ids)
+        window = self._ids[self._prefix:]
+        emitted = self._tok.decode(self._ids[self._prefix:self._read])
+        full = self._tok.decode(window)
         # Hold back a trailing replacement char: it usually means the last
         # token ends mid-UTF-8-sequence and the next token completes it.
-        safe_end = len(text)
-        if text.endswith("�"):
-            safe_end = len(text) - 1
-        if safe_end <= self._emitted:
+        if full.endswith("�") or len(full) <= len(emitted):
             return ""
-        chunk = text[self._emitted:safe_end]
-        self._emitted = safe_end
+        chunk = full[len(emitted):]
+        self._text += chunk
+        self._prefix = self._read
+        self._read = len(self._ids)
         return chunk
 
     def flush(self) -> str:
-        text = self._tok.decode(self._ids)
-        chunk = text[self._emitted:]
-        self._emitted = len(text)
+        emitted = self._tok.decode(self._ids[self._prefix:self._read])
+        full = self._tok.decode(self._ids[self._prefix:])
+        chunk = full[len(emitted):]
+        self._text += chunk
+        self._prefix = self._read = len(self._ids)
         return chunk
 
     @property
     def text(self) -> str:
-        return self._tok.decode(self._ids)
+        emitted = self._tok.decode(self._ids[self._prefix:self._read])
+        full = self._tok.decode(self._ids[self._prefix:])
+        return self._text + full[len(emitted):]
 
 
 class StopChecker:

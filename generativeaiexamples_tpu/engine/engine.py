@@ -38,7 +38,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..models import llama
 from ..models.configs import LlamaConfig
 from ..models.tokenizer import Tokenizer
-from ..ops.sampling import sample
+from ..ops.sampling import apply_repetition_penalty, sample, seen_mask
 from ..parallel.sharding import kv_cache_spec, llama_param_specs, shard_params
 from ..utils.errors import EngineError, SchedulerFullError
 from .detokenizer import IncrementalDetokenizer, StopChecker
@@ -149,6 +149,8 @@ class Engine:
             "temp": jnp.zeros((B,), jnp.float32),
             "top_k": jnp.zeros((B,), jnp.int32),
             "top_p": jnp.zeros((B,), jnp.float32),
+            "rep_pen": jnp.ones((B,), jnp.float32),
+            "seen": jnp.zeros((B, model_cfg.vocab_size), bool),
         }
         self._base_key = jax.random.key(cfg.seed)
         self._step_counter = itertools.count()
@@ -173,9 +175,9 @@ class Engine:
     def _build_jitted(self) -> None:
         cfg, mcfg = self.cfg, self.model_cfg
 
-        def prefill(params, tokens, length, temp, top_k, top_p, key):
+        def prefill(params, tokens, length, temp, top_k, top_p, rep_pen, key):
             """tokens: (1, S_bucket); returns (k,v) for the bucket, the
-            sampled first token, and the last-token logits."""
+            sampled first token, and the prompt's seen-token mask."""
             S = tokens.shape[1]
             positions = jnp.arange(S, dtype=jnp.int32)[None, :]
             cache = llama.init_kv_cache(mcfg, 1, S, self._dtype)
@@ -184,12 +186,16 @@ class Engine:
             last = jnp.take_along_axis(
                 logits, (length - 1)[None, None, None].astype(jnp.int32),
                 axis=1)[0, 0]  # (V,)
-            first_tok = sample(last[None, :], key, temp[None], top_k[None],
+            seen = seen_mask(tokens, length[None], mcfg.vocab_size)  # (1, V)
+            last = apply_repetition_penalty(last[None, :], seen,
+                                            rep_pen[None])
+            first_tok = sample(last, key, temp[None], top_k[None],
                                top_p[None])[0]
-            return cache["k"], cache["v"], first_tok
+            seen = seen[0].at[first_tok].set(True)
+            return cache["k"], cache["v"], first_tok, seen
 
         def insert(state, k_new, v_new, slot, length, first_tok,
-                   temp, top_k, top_p):
+                   temp, top_k, top_p, rep_pen, seen):
             cache = state["cache"]
             zeros5 = (0, slot, 0, 0, 0)
             cache = {
@@ -207,6 +213,8 @@ class Engine:
                 "temp": state["temp"].at[slot].set(temp),
                 "top_k": state["top_k"].at[slot].set(top_k),
                 "top_p": state["top_p"].at[slot].set(top_p),
+                "rep_pen": state["rep_pen"].at[slot].set(rep_pen),
+                "seen": state["seen"].at[slot].set(seen),
             }
 
         def decode_step(params, state, key):
@@ -216,13 +224,18 @@ class Engine:
             positions = pos[:, None]
             logits, cache = llama.apply(params, mcfg, tokens, positions,
                                         state["cache"], kv_valid_len=pos + 1)
-            next_tok = sample(logits[:, 0], key, state["temp"],
+            penalized = apply_repetition_penalty(
+                logits[:, 0], state["seen"], state["rep_pen"])
+            next_tok = sample(penalized, key, state["temp"],
                               state["top_k"], state["top_p"])
             next_tok = jnp.where(active, next_tok, 0)
             new_state = dict(state)
             new_state["cache"] = cache
             new_state["pos"] = jnp.where(active, pos + 1, pos)
             new_state["last_token"] = next_tok
+            new_state["seen"] = state["seen"].at[
+                jnp.arange(state["seen"].shape[0]), next_tok
+            ].max(active)
             return new_state, next_tok
 
         def release(state, slot):
@@ -237,6 +250,7 @@ class Engine:
 
     def start(self) -> None:
         if self._thread is None:
+            self._stopped.clear()  # allow restart after a stop()
             self._thread = threading.Thread(target=self._run, daemon=True,
                                             name="engine-loop")
             self._thread.start()
@@ -341,14 +355,15 @@ class Engine:
             length = jnp.int32(len(req.prompt_ids))
             key = jax.random.fold_in(self._base_key,
                                      next(self._step_counter) ^ sp.random_seed)
-            k_new, v_new, first_tok = self._prefill(
+            k_new, v_new, first_tok, seen = self._prefill(
                 self.params, tokens, length,
                 jnp.float32(sp.temperature), jnp.int32(sp.top_k),
-                jnp.float32(sp.top_p), key)
+                jnp.float32(sp.top_p), jnp.float32(sp.repetition_penalty), key)
             self._state = self._insert(
                 self._state, k_new, v_new, jnp.int32(slot), length, first_tok,
                 jnp.float32(sp.temperature), jnp.int32(sp.top_k),
-                jnp.float32(sp.top_p))
+                jnp.float32(sp.top_p), jnp.float32(sp.repetition_penalty),
+                seen)
             self.stats["prefills"] += 1
             self._slots[slot] = req
             self._emit(slot, req, int(first_tok))

@@ -161,13 +161,7 @@ def params_from_named_tensors(tensors: Iterator[tuple[str, Any]],
     embed: dict[str, Any] = {}
     layer_acc: dict[str, list] = {}
 
-    def to_np(t):
-        if isinstance(t, np.ndarray):
-            return t
-        import torch
-        if isinstance(t, torch.Tensor):
-            return t.detach().to(torch.float32).cpu().numpy()
-        return np.asarray(t)
+    from .import_hf import _to_numpy as to_np
 
     for key, raw in tensors:
         key = key.removeprefix("bert.")

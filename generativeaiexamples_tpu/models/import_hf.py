@@ -37,6 +37,32 @@ _HF_LAYER_KEYS = {
     "mlp.down_proj.weight": ("w_down", True),
 }
 
+# Meta/fairscale checkpoint names (consolidated.*.pth). Values: (name, kind)
+# where kind marks the extra transform — "q"/"k" rows additionally need the
+# interleaved→half-split RoPE permutation to match ops.rope's HF convention.
+_META_LAYER_KEYS = {
+    "attention_norm.weight": ("attn_norm", "plain"),
+    "ffn_norm.weight": ("mlp_norm", "plain"),
+    "attention.wq.weight": ("wq", "q"),
+    "attention.wk.weight": ("wk", "k"),
+    "attention.wv.weight": ("wv", "T"),
+    "attention.wo.weight": ("wo", "T"),
+    "feed_forward.w1.weight": ("w_gate", "T"),
+    "feed_forward.w2.weight": ("w_down", "T"),
+    "feed_forward.w3.weight": ("w_up", "T"),
+}
+
+
+def _unpermute_rope(w: np.ndarray, n_heads: int, head_dim: int) -> np.ndarray:
+    """Meta stores q/k rows in interleaved RoPE pair order; HF (and our
+    ``ops.rope``) uses the half-split layout. Same permutation HF's own
+    conversion script applies (transformers convert_llama_weights_to_hf)."""
+    out_dim, in_dim = w.shape
+    return (w.reshape(n_heads, head_dim // 2, 2, in_dim)
+             .transpose(0, 2, 1, 3)
+             .reshape(out_dim, in_dim))
+
+
 # Mixtral MoE tensor names (block_sparse_moe.*).
 _HF_MOE_GATE = "block_sparse_moe.gate.weight"
 _MOE_EXPERT_RE = re.compile(
@@ -118,13 +144,13 @@ def params_from_named_tensors(
     for key, raw in tensors:
         key = key.removeprefix("model.")
         arr = _to_numpy(raw)
-        if key == "embed_tokens.weight":
+        if key in ("embed_tokens.weight", "tok_embeddings.weight"):
             top["embed"] = arr
             continue
         if key == "norm.weight":
             top["final_norm"] = arr
             continue
-        if key == "lm_head.weight":
+        if key in ("lm_head.weight", "output.weight"):
             top["lm_head"] = arr.T
             continue
         m = re.match(r"layers\.(\d+)\.(.+)$", key)
@@ -134,6 +160,16 @@ def params_from_named_tensors(
         if rest in _HF_LAYER_KEYS:
             name, transpose = _HF_LAYER_KEYS[rest]
             put_layer(name, idx, arr.T if transpose else arr)
+            continue
+        if rest in _META_LAYER_KEYS:
+            name, kind = _META_LAYER_KEYS[rest]
+            if kind == "q":
+                arr = _unpermute_rope(arr, cfg.num_heads, cfg.head_dim).T
+            elif kind == "k":
+                arr = _unpermute_rope(arr, cfg.num_kv_heads, cfg.head_dim).T
+            elif kind == "T":
+                arr = arr.T
+            put_layer(name, idx, arr)
             continue
         if rest == _HF_MOE_GATE:
             put_layer("router", idx, arr.T)

@@ -64,22 +64,28 @@ def sample(logits: jax.Array, key: jax.Array, temperature: jax.Array,
     return jnp.where(is_greedy, greedy_ids, sampled)
 
 
-def apply_repetition_penalty(logits: jax.Array, token_history: jax.Array,
-                             valid_len: jax.Array,
-                             penalty: jax.Array) -> jax.Array:
-    """CTRL-style repetition penalty over each row's token history.
+def seen_mask(token_history: jax.Array, valid_len: jax.Array,
+              vocab_size: int) -> jax.Array:
+    """(B, V) bool mask of tokens present in each row's history.
 
-    token_history: (B, T) int32 (cache-resident prompt+generated ids),
-    valid_len: (B,), penalty: (B,) — 1.0 is a no-op.
+    token_history: (B, T) int32, valid_len: (B,) valid prefix per row.
+    """
+    B, T = token_history.shape
+    pos_valid = jnp.arange(T)[None, :] < valid_len[:, None]
+    return jnp.zeros((B, vocab_size), bool).at[
+        jnp.arange(B)[:, None], token_history
+    ].max(pos_valid)
+
+
+def apply_repetition_penalty(logits: jax.Array, seen: jax.Array,
+                             penalty: jax.Array) -> jax.Array:
+    """CTRL-style repetition penalty over already-seen tokens.
+
+    seen: (B, V) bool (from ``seen_mask`` or maintained incrementally),
+    penalty: (B,) — 1.0 is a no-op.
     Parity with the reference's ``repetition_penalty`` ensemble tensor
     (ensemble/config.pbtxt).
     """
-    B, V = logits.shape
-    T = token_history.shape[1]
-    pos_valid = jnp.arange(T)[None, :] < valid_len[:, None]
-    seen = jnp.zeros((B, V), bool).at[
-        jnp.arange(B)[:, None], token_history
-    ].max(pos_valid)
     pen = penalty[:, None]
     lf = logits.astype(jnp.float32)
     penalized = jnp.where(lf > 0, lf / pen, lf * pen)

@@ -1,0 +1,25 @@
+"""Vector retrieval: first-party stores + external-engine connectors.
+
+The TPU-native answer to the reference's vector-store layer
+(reference: common/utils.py:143-225 wires Milvus GPU_IVF_FLAT, FAISS,
+pgvector). Components:
+
+- ``store``      VectorStore interface + factory.
+- ``exact``      Exact top-k store (numpy / native C++ / TPU matmul backends).
+- ``ivf``        IVF-Flat ANN store (nlist/nprobe parity with the reference's
+                 Milvus GPU_IVF_FLAT defaults, nlist=64 nprobe=16).
+- ``tpu_search`` On-device brute-force top-k via jit matmul + lax.top_k.
+- ``native``     C++ kernels (OpenMP) behind ctypes, compiled on demand.
+- ``connectors`` Gated Milvus / pgvector client stores.
+- ``docstore``   DocumentIndex: embedder + store + text/metadata persistence.
+"""
+
+from .store import SearchHit, VectorStore, get_vector_store
+from .exact import ExactStore
+from .ivf import IVFFlatStore
+from .docstore import Document, DocumentIndex
+
+__all__ = [
+    "SearchHit", "VectorStore", "get_vector_store", "ExactStore",
+    "IVFFlatStore", "Document", "DocumentIndex",
+]

@@ -1,0 +1,98 @@
+"""DocumentIndex: embedder + vector store + text/metadata in one object.
+
+The working unit the chain server ingests into and retrieves from — the
+role LlamaIndex's ``VectorStoreIndex`` / LangChain's vectorstore wrappers
+play in the reference (reference: common/utils.py:143-229,
+examples/developer_rag/chains.py:77-80 ``insert_nodes``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from .store import VectorStore, get_vector_store
+
+
+@dataclass
+class Document:
+    """A retrievable chunk: text + metadata (+ score when returned)."""
+    text: str
+    metadata: dict[str, Any] = field(default_factory=dict)
+    id: Optional[int] = None
+    score: Optional[float] = None
+
+
+class DocumentIndex:
+    def __init__(self, embedder, store: Optional[VectorStore] = None,
+                 store_name: str = "exact"):
+        self.embedder = embedder
+        self.store = store or get_vector_store(store_name, dim=embedder.dim)
+        self._docs: dict[int, Document] = {}
+
+    def __len__(self) -> int:
+        return len(self.store)
+
+    def add_documents(self, docs: Sequence[Document]) -> list[int]:
+        if not docs:
+            return []
+        emb = self.embedder.embed_documents([d.text for d in docs])
+        ids = self.store.add(np.asarray(emb, np.float32))
+        for i, doc in zip(ids, docs):
+            doc.id = i
+            self._docs[i] = doc
+        return ids
+
+    def add_texts(self, texts: Sequence[str],
+                  metadatas: Optional[Sequence[dict]] = None) -> list[int]:
+        metadatas = metadatas or [{} for _ in texts]
+        return self.add_documents(
+            [Document(text=t, metadata=dict(m))
+             for t, m in zip(texts, metadatas)])
+
+    def similarity_search(self, query: str, k: int = 4) -> list[Document]:
+        """Top-k documents for a text query (embedder's query mode)."""
+        if len(self.store) == 0:
+            return []
+        q = np.asarray(self.embedder.embed_query(query), np.float32)
+        hits = self.store.search(q, k=k)[0]
+        out = []
+        for hit in hits:
+            doc = self._docs.get(hit.id)
+            if doc is not None:
+                out.append(Document(text=doc.text, metadata=doc.metadata,
+                                    id=hit.id, score=hit.score))
+        return out
+
+    def delete(self, ids: Sequence[int]) -> None:
+        self.store.delete(ids)
+        for i in ids:
+            self._docs.pop(i, None)
+
+    def sources(self) -> list[str]:
+        """Distinct source filenames across the index (for the KB page;
+        reference: frontend kb.py file table)."""
+        names = {d.metadata.get("source", "") for d in self._docs.values()}
+        return sorted(n for n in names if n)
+
+    # ---------------------------------------------------------- persistence
+
+    def save(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+        self.store.save(os.path.join(path, "store"))
+        with open(os.path.join(path, "docs.jsonl"), "w") as f:
+            for i, doc in sorted(self._docs.items()):
+                f.write(json.dumps(
+                    {"id": i, "text": doc.text, "metadata": doc.metadata}) + "\n")
+
+    def load_docs(self, path: str) -> None:
+        """Restore texts/metadata; the store is reloaded by its own class."""
+        with open(os.path.join(path, "docs.jsonl")) as f:
+            for line in f:
+                rec = json.loads(line)
+                self._docs[rec["id"]] = Document(
+                    text=rec["text"], metadata=rec["metadata"], id=rec["id"])

@@ -147,3 +147,59 @@ def test_moe_forward_runs():
     logits, _ = llama.apply(params, cfg, tokens, pos)
     assert logits.shape == (1, 7, 128)
     assert bool(jnp.isfinite(logits).all())
+
+
+def test_meta_pth_import_matches_hf(hf_model_and_params):
+    """A Meta-format (fairscale-named, interleaved-RoPE) rendering of the same
+    weights must import to the identical param tree as the HF naming."""
+    import numpy as np
+    import torch
+
+    from generativeaiexamples_tpu.models import import_hf
+
+    hf_model, params = hf_model_and_params
+    cfg = LLAMA_TINY
+    sd = hf_model.state_dict()
+
+    def permute_to_meta(w, n_heads):
+        # inverse of transformers' convert_llama_weights_to_hf permutation
+        out_dim, in_dim = w.shape
+        return (w.reshape(n_heads, 2, cfg.head_dim // 2, in_dim)
+                 .transpose(0, 2, 1, 3).reshape(out_dim, in_dim))
+
+    meta = {}
+    for key, t in sd.items():
+        arr = t.detach().to(torch.float32).numpy()
+        key = key.removeprefix("model.")
+        if key == "embed_tokens.weight":
+            meta["tok_embeddings.weight"] = arr
+        elif key == "norm.weight":
+            meta["norm.weight"] = arr
+        elif key == "lm_head.weight":
+            meta["output.weight"] = arr
+        else:
+            m = key.split(".")
+            li, rest = m[1], ".".join(m[2:])
+            name_map = {
+                "input_layernorm.weight": "attention_norm.weight",
+                "post_attention_layernorm.weight": "ffn_norm.weight",
+                "self_attn.q_proj.weight": "attention.wq.weight",
+                "self_attn.k_proj.weight": "attention.wk.weight",
+                "self_attn.v_proj.weight": "attention.wv.weight",
+                "self_attn.o_proj.weight": "attention.wo.weight",
+                "mlp.gate_proj.weight": "feed_forward.w1.weight",
+                "mlp.up_proj.weight": "feed_forward.w3.weight",
+                "mlp.down_proj.weight": "feed_forward.w2.weight",
+            }
+            if rest == "self_attn.q_proj.weight":
+                arr = permute_to_meta(arr, cfg.num_heads)
+            elif rest == "self_attn.k_proj.weight":
+                arr = permute_to_meta(arr, cfg.num_kv_heads)
+            meta[f"layers.{li}.{name_map[rest]}"] = arr
+
+    got = import_hf.params_from_named_tensors(
+        iter(meta.items()), cfg, dtype=jnp.float32)
+    def cmp(a, b):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+    import jax
+    jax.tree.map(cmp, got, params)

@@ -1,0 +1,167 @@
+"""Retrieval-layer tests: exact/IVF/TPU/native backends agree; persistence;
+DocumentIndex round trip. (The reference ships no Python tests at all —
+SURVEY.md §4 — so these set the bar it lacked.)"""
+
+import numpy as np
+import pytest
+
+from generativeaiexamples_tpu.embed.encoder import HashEmbedder
+from generativeaiexamples_tpu.retrieval import (
+    Document, DocumentIndex, ExactStore, IVFFlatStore, get_vector_store)
+from generativeaiexamples_tpu.retrieval.store import score_matrix
+
+
+def _corpus(n=400, d=32, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    return x / np.linalg.norm(x, axis=1, keepdims=True)
+
+
+def _brute_ref(base, q, k, metric):
+    scores = score_matrix(base, q[None, :], metric)[0]
+    return np.argsort(-scores)[:k]
+
+
+@pytest.mark.parametrize("metric", ["ip", "l2"])
+@pytest.mark.parametrize("backend", ["numpy", "auto"])
+def test_exact_matches_reference(metric, backend):
+    base = _corpus()
+    store = ExactStore(dim=base.shape[1], metric=metric, backend=backend)
+    ids = store.add(base)
+    assert ids == list(range(len(base)))
+    q = _corpus(3, base.shape[1], seed=7)
+    for row in q:
+        hits = store.search(row, k=5)[0]
+        expect = _brute_ref(base, row, 5, metric)
+        assert [h.id for h in hits] == list(expect)
+        scores = [h.score for h in hits]
+        assert scores == sorted(scores, reverse=True)
+
+
+def test_exact_tpu_backend_matches_numpy():
+    base = _corpus(200, 64)
+    q = _corpus(4, 64, seed=3)
+    ref = ExactStore(dim=64, backend="numpy")
+    tpu = ExactStore(dim=64, backend="tpu")
+    ref.add(base)
+    tpu.add(base)
+    for row in q:
+        ids_ref = [h.id for h in ref.search(row, k=8)[0]]
+        ids_tpu = [h.id for h in tpu.search(row, k=8)[0]]
+        assert ids_ref == ids_tpu
+
+
+def test_exact_delete_and_grow():
+    base = _corpus(50, 16)
+    store = ExactStore(dim=16, capacity=8)  # forces several grows
+    store.add(base)
+    assert len(store) == 50
+    target = store.search(base[10], k=1)[0][0]
+    assert target.id == 10
+    store.delete([10])
+    assert len(store) == 49
+    hits = store.search(base[10], k=3)[0]
+    assert 10 not in [h.id for h in hits]
+
+
+def test_exact_persistence_roundtrip(tmp_path):
+    base = _corpus(30, 16)
+    store = ExactStore(dim=16)
+    store.add(base)
+    store.delete([3])
+    store.save(str(tmp_path))
+    loaded = ExactStore.load(str(tmp_path))
+    assert len(loaded) == 29
+    q = base[5]
+    assert ([h.id for h in loaded.search(q, k=4)[0]]
+            == [h.id for h in store.search(q, k=4)[0]])
+
+
+def test_ivf_recall_against_exact():
+    base = _corpus(600, 32, seed=1)
+    ivf = IVFFlatStore(dim=32, nlist=16, nprobe=8)
+    ivf.add(base)
+    exact = ExactStore(dim=32, backend="numpy")
+    exact.add(base)
+    q = _corpus(10, 32, seed=9)
+    hits_at_4 = 0
+    for row in q:
+        got = {h.id for h in ivf.search(row, k=4)[0]}
+        want = {h.id for h in exact.search(row, k=4)[0]}
+        hits_at_4 += len(got & want)
+    recall = hits_at_4 / (4 * len(q))
+    assert recall >= 0.7, f"IVF recall@4 too low: {recall}"
+
+
+def test_ivf_small_corpus_brute_force_exact():
+    # Below train_min the IVF store must be exhaustive (exact).
+    base = _corpus(40, 16)
+    ivf = IVFFlatStore(dim=16, nlist=64, nprobe=16)
+    ivf.add(base)
+    q = base[7]
+    assert ivf.search(q, k=1)[0][0].id == 7
+
+
+def test_ivf_persistence(tmp_path):
+    base = _corpus(300, 16)
+    ivf = IVFFlatStore(dim=16, nlist=8, nprobe=8)
+    ivf.add(base)
+    ivf.save(str(tmp_path))
+    loaded = IVFFlatStore.load(str(tmp_path))
+    assert len(loaded) == 300
+    assert loaded.search(base[0], k=1)[0][0].id == 0
+
+
+def test_native_kernel_if_available():
+    from generativeaiexamples_tpu.retrieval import native
+    if native.load() is None:
+        pytest.skip("no native toolchain")
+    base = _corpus(500, 48)
+    q = _corpus(6, 48, seed=2)
+    out = native.brute_topk(base, q, 10, 0)
+    assert out is not None
+    idx, score = out
+    for qi in range(q.shape[0]):
+        expect = _brute_ref(base, q[qi], 10, "ip")
+        assert list(idx[qi]) == list(expect)
+        np.testing.assert_allclose(score[qi], (base @ q[qi])[expect],
+                                   rtol=1e-5)
+
+
+def test_store_factory_unknown():
+    with pytest.raises(ValueError):
+        get_vector_store("bogus")
+
+
+def test_document_index_end_to_end(tmp_path):
+    emb = HashEmbedder(dim=64)
+    index = DocumentIndex(emb)
+    index.add_texts(
+        ["TPUs use a systolic array called the MXU for matmuls.",
+         "The Eiffel Tower is in Paris, France.",
+         "JAX compiles programs with XLA for TPU execution.",
+         "Milvus is a vector database."],
+        metadatas=[{"source": "tpu.txt"}, {"source": "travel.txt"},
+                   {"source": "tpu.txt"}, {"source": "db.txt"}])
+    docs = index.similarity_search("systolic array MXU matmul", k=2)
+    assert any("MXU" in d.text for d in docs)
+    assert docs[0].score is not None
+    assert index.sources() == ["db.txt", "tpu.txt", "travel.txt"]
+
+    index.save(str(tmp_path))
+    store2 = ExactStore.load(str(tmp_path / "store"))
+    index2 = DocumentIndex(emb, store=store2)
+    index2.load_docs(str(tmp_path))
+    docs2 = index2.similarity_search("systolic array MXU matmul", k=2)
+    assert [d.text for d in docs2] == [d.text for d in docs]
+
+
+def test_connectors_gated():
+    from generativeaiexamples_tpu.utils.errors import ConfigError
+    try:
+        import pymilvus  # noqa: F401
+        pytest.skip("pymilvus installed")
+    except ImportError:
+        pass
+    with pytest.raises(ConfigError, match="pymilvus"):
+        get_vector_store("milvus", dim=8)
