@@ -1,0 +1,15 @@
+"""Chain server: pluggable RAG pipelines behind a 3-endpoint HTTP API.
+
+The heart of the reference (SURVEY.md §1 L5): FastAPI + LangChain/LlamaIndex
+chain server (reference: RetrievalAugmentedGeneration/common/server.py).
+Here the same public API — ``POST /uploadDocument``, ``POST /generate``
+(streaming), ``POST /documentSearch`` — is served by aiohttp, and the chains
+are first-party: no LangChain/LlamaIndex dependency, the retrieval and
+generation building blocks come from this framework's own layers.
+"""
+
+from .base import BaseExample
+from .llm import LLM, get_llm
+from .splitter import TokenTextSplitter
+
+__all__ = ["BaseExample", "LLM", "get_llm", "TokenTextSplitter"]

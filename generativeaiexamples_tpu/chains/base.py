@@ -1,0 +1,34 @@
+"""The pluggable-example contract.
+
+Exact parity with the reference's ABC (reference: common/base.py:21-33):
+``llm_chain`` / ``rag_chain`` stream answer text, ``ingest_docs`` loads a
+file into the knowledge base; ``document_search`` is optional and duck-typed
+by the server (reference: common/server.py:152).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Generator
+
+
+class BaseExample(abc.ABC):
+    """Base class for all chain-server examples."""
+
+    @abc.abstractmethod
+    def llm_chain(self, context: str, question: str, num_tokens: int,
+                  ) -> Generator[str, None, None]:
+        """Answer ``question`` with the LLM alone (no knowledge base);
+        ``context`` is caller-supplied free text."""
+
+    @abc.abstractmethod
+    def rag_chain(self, prompt: str, num_tokens: int,
+                  ) -> Generator[str, None, None]:
+        """Answer using retrieval over the ingested knowledge base."""
+
+    @abc.abstractmethod
+    def ingest_docs(self, data_dir: str, filename: str) -> None:
+        """Load a document file into the knowledge base."""
+
+    # Optional (duck-typed by the server, like the reference):
+    # def document_search(self, content: str, num_docs: int) -> list[dict]

@@ -1,0 +1,1 @@
+"""Built-in chain-server examples (reference: RetrievalAugmentedGeneration/examples/)."""

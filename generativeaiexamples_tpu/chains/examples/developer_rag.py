@@ -1,0 +1,102 @@
+"""The canonical QA chatbot: ingest → retrieve → prompt → stream.
+
+Parity with the reference's developer RAG example
+(reference: examples/developer_rag/chains.py — ``QAChatbot``:
+``ingest_docs`` 51 loads PDFs/files and chunks them into the vector store,
+``llm_chain`` 86 answers without retrieval, ``rag_chain`` 101 retrieves
+top-4 / caps context at 1500 tokens / streams through the LLM,
+``document_search`` 136 exposes raw retrieval). Built on this framework's
+own retrieval + LLM layers instead of LlamaIndex.
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+from typing import Generator, Optional
+
+from ...embed.encoder import get_embedder
+from ...retrieval.docstore import Document, DocumentIndex
+from ...utils.app_config import get_config
+from ...utils.logging import get_logger
+from ..base import BaseExample
+from ..llm import get_llm
+from ..readers import read_document
+from ..splitter import TokenTextSplitter, cap_context
+
+logger = get_logger(__name__)
+
+
+class QAChatbot(BaseExample):
+    """Canonical developer RAG chatbot."""
+
+    def __init__(self, llm=None, embedder=None, index: Optional[DocumentIndex] = None,
+                 config=None, engine=None):
+        self.config = config or get_config()
+        self.llm = llm or get_llm(self.config, engine=engine)
+        embedder = embedder or (index.embedder if index else None) or \
+            get_embedder(self.config.embeddings.model_engine,
+                         self.config.embeddings.model_name,
+                         dim=self.config.embeddings.dimensions)
+        if index is None:
+            from ...retrieval.store import store_from_config
+            index = DocumentIndex(embedder, store=store_from_config(
+                self.config.vector_store, embedder.dim))
+        self.index = index
+        self.splitter = TokenTextSplitter(
+            chunk_size=self.config.text_splitter.chunk_size,
+            chunk_overlap=self.config.text_splitter.chunk_overlap)
+
+    # ----------------------------------------------------------- ingestion
+
+    def ingest_docs(self, data_dir: str, filename: str) -> None:
+        """Read, chunk, and index one document file.
+
+        The reference base64-encodes the filename into node metadata to
+        survive odd characters (reference: chains.py:68-75); kept here.
+        """
+        text = read_document(data_dir)
+        chunks = self.splitter.split_text(text)
+        encoded = base64.b64encode(filename.encode()).decode()
+        docs = [Document(text=c, metadata={"source": filename,
+                                           "source_b64": encoded,
+                                           "chunk": i})
+                for i, c in enumerate(chunks)]
+        self.index.add_documents(docs)
+        logger.info("ingested %s: %d chunks", filename, len(chunks))
+
+    # -------------------------------------------------------------- chains
+
+    def llm_chain(self, context: str, question: str, num_tokens: int,
+                  ) -> Generator[str, None, None]:
+        prompt = self.config.prompts.chat_template.format(
+            context_str=context or "", query_str=question)
+        yield from self.llm.stream(prompt, max_tokens=num_tokens,
+                                   stop=["</s>", "[INST]"])
+
+    def rag_chain(self, prompt: str, num_tokens: int,
+                  ) -> Generator[str, None, None]:
+        docs = self.index.similarity_search(prompt,
+                                            k=self.config.retriever.top_k)
+        context_texts = cap_context(
+            [d.text for d in docs],
+            max_tokens=self.config.retriever.max_context_tokens,
+            tokenizer=self.splitter.tok)
+        context = "\n\n".join(context_texts)
+        full_prompt = self.config.prompts.rag_template.format(
+            context_str=context, query_str=prompt)
+        yield from self.llm.stream(full_prompt, max_tokens=num_tokens,
+                                   stop=["</s>", "[INST]"])
+
+    # ------------------------------------------------------------- search
+
+    def document_search(self, content: str, num_docs: int) -> list[dict]:
+        """Raw retrieval results (reference: chains.py:136-153 returns
+        [{score, source, content}])."""
+        docs = self.index.similarity_search(content, k=num_docs)
+        return [{"score": d.score,
+                 "source": d.metadata.get("source", ""),
+                 "content": d.text} for d in docs]
+
+
+Example = QAChatbot

@@ -1,0 +1,152 @@
+"""LLM client abstraction for the chains layer.
+
+Parity with the reference's ``get_llm`` factory hub
+(reference: common/utils.py:236-266 switches on ``model_engine``:
+triton-trt-llm / nv-ai-foundation / nemo-infer / ...). Engines here:
+
+- ``tpu-jax``       in-process continuous-batching Engine (zero-copy path).
+- ``openai-compat`` HTTP client for any OpenAI-style ``/v1/completions``
+                    server — including this framework's own ``serving`` API
+                    (parity with the nemo-infer connector,
+                    reference: integrations/langchain/llms/nemo_infer.py).
+- ``echo``          deterministic test double (the 'fake engine' the
+                    reference's enum invited but never shipped, SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import abc
+import json
+from typing import Iterator, Optional
+
+from ..utils.errors import ConfigError
+
+
+class LLM(abc.ABC):
+    """Minimal streaming text-completion interface used by all chains."""
+
+    @abc.abstractmethod
+    def stream(self, prompt: str, max_tokens: int = 256,
+               stop: Optional[list[str]] = None, temperature: float = 1.0,
+               top_k: int = 1, top_p: float = 0.0,
+               ) -> Iterator[str]:
+        """Yield answer text chunks. Default sampling mirrors the
+        reference's client defaults (trt_llm.py:68-74: temp 1.0, top_k 1)."""
+
+    def complete(self, prompt: str, **kw) -> str:
+        return "".join(self.stream(prompt, **kw))
+
+
+class EchoLLM(LLM):
+    """Deterministic test double: echoes a transform of the prompt tail."""
+
+    def __init__(self, prefix: str = "ECHO: ", tail_chars: int = 160):
+        self.prefix = prefix
+        self.tail_chars = tail_chars
+        self.calls: list[str] = []
+
+    def stream(self, prompt: str, max_tokens: int = 256,
+               stop: Optional[list[str]] = None, temperature: float = 1.0,
+               top_k: int = 1, top_p: float = 0.0) -> Iterator[str]:
+        self.calls.append(prompt)
+        tail = prompt[-self.tail_chars:]
+        # A real model never echoes its chat scaffold; scrub template
+        # markers so caller-supplied stop words don't trip on the echo.
+        for marker in ("<s>", "</s>", "[INST]", "[/INST]",
+                       "<<SYS>>", "<</SYS>>"):
+            tail = tail.replace(marker, "")
+        text = (self.prefix + tail)[:max_tokens]
+        for s in stop or []:
+            idx = text.find(s)
+            if idx >= 0:
+                text = text[:idx]
+        for i in range(0, len(text), 7):  # chunked like a real stream
+            yield text[i:i + 7]
+
+
+class EngineLLM(LLM):
+    """In-process engine: the TPU-native equivalent of pointing LangChain's
+    TritonClient at a local Triton (reference: trt_llm.py:124 ``_call``) —
+    minus the gRPC hop, because the engine lives in this process."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        engine.start()
+
+    def stream(self, prompt: str, max_tokens: int = 256,
+               stop: Optional[list[str]] = None, temperature: float = 1.0,
+               top_k: int = 1, top_p: float = 0.0) -> Iterator[str]:
+        from ..engine.sampling_params import SamplingParams
+        params = SamplingParams(max_tokens=max_tokens,
+                                stop_words=list(stop or []),
+                                temperature=temperature, top_k=top_k,
+                                top_p=top_p)
+        return iter(self.engine.stream_text(prompt, params))
+
+
+class OpenAICompatLLM(LLM):
+    """Streaming client for ``/v1/completions`` SSE servers.
+
+    Unlike the reference's nemo-infer client — which must diff cumulative
+    text to recover per-token deltas (reference: nemo_infer.py:141-156) —
+    OpenAI-style servers send true deltas, so chunks pass through as-is.
+    """
+
+    def __init__(self, server_url: str, model_name: str = "default",
+                 timeout: float = 120.0, send_top_k: bool = True):
+        if not server_url:
+            raise ConfigError("openai-compat engine requires llm.server_url")
+        self.url = server_url.rstrip("/") + "/v1/completions"
+        self.model_name = model_name
+        self.timeout = timeout
+        # top_k is this framework's extension; disable against servers that
+        # reject unknown sampling arguments.
+        self.send_top_k = send_top_k
+
+    def stream(self, prompt: str, max_tokens: int = 256,
+               stop: Optional[list[str]] = None, temperature: float = 1.0,
+               top_k: int = 1, top_p: float = 0.0) -> Iterator[str]:
+        import requests
+
+        body = {"model": self.model_name, "prompt": prompt,
+                "max_tokens": max_tokens, "stream": True,
+                "temperature": temperature, "top_p": top_p,
+                "stop": list(stop or [])}
+        if top_k == 1:
+            # Express greedy via temperature=0 — portable to servers that
+            # reject non-standard arguments (the real OpenAI API 400s on
+            # unknown fields).
+            body["temperature"] = 0.0
+        elif top_k > 1 and self.send_top_k:
+            body["top_k"] = top_k
+        with requests.post(self.url, json=body, stream=True,
+                           timeout=self.timeout) as resp:
+            resp.raise_for_status()
+            for line in resp.iter_lines(decode_unicode=True):
+                if not line or not line.startswith("data:"):
+                    continue
+                payload = line[len("data:"):].strip()
+                if payload == "[DONE]":
+                    return
+                choice = json.loads(payload)["choices"][0]
+                if choice.get("text"):
+                    yield choice["text"]
+
+
+def get_llm(config=None, engine=None) -> LLM:
+    """Engine-switched factory (reference: common/utils.py:236-266)."""
+    if config is None:
+        from ..utils.app_config import get_config
+        config = get_config()
+    kind = config.llm.model_engine
+    if kind == "echo":
+        return EchoLLM()
+    if kind == "tpu-jax":
+        if engine is None:
+            raise ConfigError(
+                "model_engine=tpu-jax needs an in-process Engine instance "
+                "(pass engine=); for a remote server use openai-compat")
+        return EngineLLM(engine)
+    if kind in ("openai-compat", "tpu-http"):
+        return OpenAICompatLLM(config.llm.server_url, config.llm.model_name)
+    raise ConfigError(f"unknown llm.model_engine {kind!r}")

@@ -1,0 +1,206 @@
+"""The chain server: 3-endpoint HTTP API over a pluggable example.
+
+API parity with the reference (reference: common/server.py):
+  POST /uploadDocument   multipart file upload → example.ingest_docs
+                         (reference: server.py:89-118)
+  POST /generate         {question, context, use_knowledge_base, num_tokens}
+                         → streaming text/event-stream response
+                         (reference: server.py:121-142)
+  POST /documentSearch   {content, num_docs} → [{score, source, content}]
+                         (reference: server.py:145-159)
+plus GET /health. Examples are discovered dynamically by module path
+(reference walks a directory and reflects for BaseExample implementors,
+server.py:56-86; here the module name comes from config/env — same
+late-binding, explicit instead of filesystem-copy magic).
+
+Sync chain generators run on a worker thread; chunks cross into the event
+loop through an asyncio queue, so one slow generation never blocks other
+requests (the aiohttp equivalent of FastAPI's StreamingResponse-over-
+threadpool).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import importlib
+import inspect
+import json
+import os
+from typing import Optional
+
+from aiohttp import web
+
+from ..obs import metrics as obs_metrics
+from ..obs.tracing import instrumented, server_span
+from ..utils.errors import ChainError
+from ..utils.logging import get_logger
+from .base import BaseExample
+
+logger = get_logger(__name__)
+
+_SENTINEL = object()
+
+
+def discover_example(spec: str) -> type[BaseExample]:
+    """Resolve an example class from a module spec.
+
+    ``spec`` is a module path (``generativeaiexamples_tpu.chains.examples.
+    developer_rag``) or a shorthand name of a built-in example
+    (``developer_rag``). The module is scanned for concrete BaseExample
+    subclasses — mirror of the reference's reflection walk
+    (reference: common/server.py:56-86).
+    """
+    if "." not in spec:
+        spec = f"{__package__}.examples.{spec}"
+    module = importlib.import_module(spec)
+    for _, obj in inspect.getmembers(module, inspect.isclass):
+        if (issubclass(obj, BaseExample) and obj is not BaseExample
+                and not inspect.isabstract(obj)):
+            return obj
+    raise ChainError(f"no BaseExample implementation found in {spec}")
+
+
+def create_app(example: BaseExample,
+               upload_dir: str = "./uploaded_files") -> web.Application:
+    app = web.Application(client_max_size=100 * 1024 ** 2)
+
+    async def health(request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    @instrumented("upload_document")
+    async def upload_document(request: web.Request) -> web.Response:
+        # reference: server.py:91-118 — save then ingest
+        reader = await request.multipart()
+        field = await reader.next()
+        while field is not None and field.name != "file":
+            field = await reader.next()
+        if field is None:
+            raise web.HTTPUnprocessableEntity(text="no 'file' field")
+        filename = os.path.basename(field.filename or "upload.bin")
+        os.makedirs(upload_dir, exist_ok=True)
+        path = os.path.join(upload_dir, filename)
+        with open(path, "wb") as f:
+            while True:
+                chunk = await field.read_chunk()
+                if not chunk:
+                    break
+                f.write(chunk)
+        try:
+            await asyncio.get_running_loop().run_in_executor(
+                None, example.ingest_docs, path, filename)
+        except Exception as exc:  # noqa: BLE001 — degrade like the reference
+            logger.exception("ingest failed for %s", filename)
+            raise web.HTTPInternalServerError(
+                text=f"ingest failed: {exc}") from exc
+        obs_metrics.REGISTRY.counter("documents_ingested_total").inc()
+        return web.json_response({"filename": filename, "status": "ingested"})
+
+    @instrumented("generate_answer")
+    async def generate_answer(request: web.Request) -> web.StreamResponse:
+        # reference: server.py:121-142 — Prompt schema + SSE streaming
+        body = await request.json()
+        question = body.get("question", "")
+        context = body.get("context", "")
+        use_kb = bool(body.get("use_knowledge_base", True))
+        num_tokens = int(body.get("num_tokens", 256))
+        if not question:
+            raise web.HTTPUnprocessableEntity(text="'question' is required")
+
+        resp = web.StreamResponse(
+            headers={"Content-Type": "text/event-stream",
+                     "Cache-Control": "no-cache"})
+        await resp.prepare(request)
+
+        loop = asyncio.get_running_loop()
+        # Unbounded thread-safe queue + cancellation flag: the producer
+        # must never block on a dead consumer (a client disconnect would
+        # otherwise wedge the executor thread forever). Memory stays
+        # bounded by num_tokens.
+        import queue as _queue
+        chunks: "_queue.SimpleQueue" = _queue.SimpleQueue()
+        cancelled = False
+
+        def produce() -> None:
+            timer = obs_metrics.RequestTimer("chain_generate")
+            try:
+                gen = (example.rag_chain(question, num_tokens) if use_kb
+                       else example.llm_chain(context, question, num_tokens))
+                for chunk in gen:
+                    if cancelled:
+                        gen.close()
+                        break
+                    timer.token(len(chunk))
+                    chunks.put(chunk)
+            except Exception as exc:  # noqa: BLE001
+                logger.exception("generation failed")
+                # degrade to a user-readable error in-stream
+                # (reference: server.py:136-142)
+                chunks.put(f"\n[error] {exc}")
+            finally:
+                timer.finish()
+                chunks.put(_SENTINEL)
+
+        producer = loop.run_in_executor(None, produce)
+        try:
+            while True:
+                try:
+                    chunk = chunks.get_nowait()
+                except _queue.Empty:
+                    await asyncio.sleep(0.005)
+                    continue
+                if chunk is _SENTINEL:
+                    break
+                await resp.write(chunk.encode("utf-8"))
+        except (ConnectionResetError, ConnectionError):
+            logger.info("client disconnected mid-stream")
+        finally:
+            cancelled = True
+            await producer
+        await resp.write_eof()
+        return resp
+
+    @instrumented("document_search")
+    async def document_search(request: web.Request) -> web.Response:
+        # reference: server.py:145-159 — duck-typed document_search
+        body = await request.json()
+        content = body.get("content", "")
+        num_docs = int(body.get("num_docs", 4))
+        search = getattr(example, "document_search", None)
+        if search is None:
+            return web.json_response([])
+        result = await asyncio.get_running_loop().run_in_executor(
+            None, search, content, num_docs)
+        return web.json_response(result)
+
+    async def metrics_endpoint(request: web.Request) -> web.Response:
+        return web.Response(text=obs_metrics.REGISTRY.render_prometheus(),
+                            content_type="text/plain")
+
+    app.router.add_get("/health", health)
+    app.router.add_get("/metrics", metrics_endpoint)
+    app.router.add_post("/uploadDocument", upload_document)
+    app.router.add_post("/generate", generate_answer)
+    app.router.add_post("/documentSearch", document_search)
+    return app
+
+
+def main(argv: Optional[list[str]] = None) -> None:
+    """CLI: ``python -m generativeaiexamples_tpu.chains.server``."""
+    import argparse
+
+    parser = argparse.ArgumentParser(description="TPU RAG chain server")
+    parser.add_argument("--example", default=os.environ.get(
+        "APP_EXAMPLE", "developer_rag"))
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8081)
+    parser.add_argument("--upload-dir", default="./uploaded_files")
+    args = parser.parse_args(argv)
+
+    example_cls = discover_example(args.example)
+    example = example_cls()
+    web.run_app(create_app(example, args.upload_dir),
+                host=args.host, port=args.port)
+
+
+if __name__ == "__main__":
+    main()

@@ -260,6 +260,12 @@ class Engine:
         self._wake.set()
         if self._thread is not None:
             self._thread.join(timeout=30)
+            if self._thread.is_alive():
+                # Loop is wedged (e.g. a huge first-time compile). Keep the
+                # handle so a later start() can't spawn a second loop racing
+                # this one over the donated device state.
+                raise EngineError(
+                    "engine loop did not stop within 30s; not restartable")
             self._thread = None
 
     def __enter__(self) -> "Engine":

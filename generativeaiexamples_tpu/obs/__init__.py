@@ -1,0 +1,11 @@
+"""Observability: OTel tracing spine + first-party metrics.
+
+Parity with the reference's tracing stack (reference: common/tracing.py,
+frontend/frontend/tracing.py, tools/observability/llamaindex/
+opentelemetry_callback.py) plus the metrics registry the reference lacks
+(SURVEY.md §5: "No first-party metrics registry — a gap to fix").
+"""
+
+from . import metrics, tracing
+
+__all__ = ["metrics", "tracing"]

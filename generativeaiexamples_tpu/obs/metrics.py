@@ -1,0 +1,173 @@
+"""First-party metrics: counters, histograms, TTFT/TPS request timing.
+
+The reference exposes only Triton's own :8002 metrics port and has a
+"TODO: metrics" in the operator (reference: docker-compose.yaml:13-19,
+helmpipeline_controller.go:109) — no app-level registry at all. This module
+fixes that gap: process-wide registry, Prometheus text rendering, and a
+RequestTimer capturing the serving metrics that matter (time-to-first-token,
+tokens/sec) per request class.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional, Sequence
+
+_DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.2, 0.4, 0.8, 1.6, 3.2,
+                    6.4, 12.8, 30.0, 60.0)
+
+
+class Counter:
+    def __init__(self, name: str, help_txt: str = ""):
+        self.name = name
+        self.help = help_txt
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Gauge(Counter):
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = value
+
+
+class Histogram:
+    def __init__(self, name: str, help_txt: str = "",
+                 buckets: Sequence[float] = _DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help_txt
+        self.buckets = tuple(sorted(buckets))
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._total = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self._sum += value
+            self._total += 1
+            for i, edge in enumerate(self.buckets):
+                if value <= edge:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    def percentile(self, q: float) -> float:
+        """Approximate percentile from bucket midpoints (p50/p99 health)."""
+        with self._lock:
+            if self._total == 0:
+                return 0.0
+            target = q * self._total
+            seen = 0
+            for i, edge in enumerate(self.buckets):
+                seen += self._counts[i]
+                if seen >= target:
+                    return edge
+            return self.buckets[-1]
+
+    @property
+    def count(self) -> int:
+        return self._total
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._metrics: dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, cls, name: str, help_txt: str, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help_txt, **kw)
+                self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help_txt: str = "") -> Counter:
+        return self._get(Counter, name, help_txt)
+
+    def gauge(self, name: str, help_txt: str = "") -> Gauge:
+        return self._get(Gauge, name, help_txt)
+
+    def histogram(self, name: str, help_txt: str = "",
+                  buckets: Sequence[float] = _DEFAULT_BUCKETS) -> Histogram:
+        return self._get(Histogram, name, help_txt, buckets=buckets)
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition format."""
+        lines: list[str] = []
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            if isinstance(m, Histogram):
+                lines.append(f"# TYPE {m.name} histogram")
+                cum = 0
+                for i, edge in enumerate(m.buckets):
+                    cum += m._counts[i]
+                    lines.append(f'{m.name}_bucket{{le="{edge}"}} {cum}')
+                lines.append(f'{m.name}_bucket{{le="+Inf"}} {m.count}')
+                lines.append(f"{m.name}_sum {m.sum}")
+                lines.append(f"{m.name}_count {m.count}")
+            else:
+                kind = "gauge" if isinstance(m, Gauge) else "counter"
+                lines.append(f"# TYPE {m.name} {kind}")
+                lines.append(f"{m.name} {m.value}")
+        return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> dict[str, float]:
+        with self._lock:
+            out: dict[str, float] = {}
+            for name, m in self._metrics.items():
+                if isinstance(m, Histogram):
+                    out[f"{name}_count"] = float(m.count)
+                    out[f"{name}_sum"] = m.sum
+                else:
+                    out[name] = m.value
+            return out
+
+
+REGISTRY = Registry()
+
+
+class RequestTimer:
+    """Per-request serving metrics: TTFT, duration, token throughput.
+
+    Tracks the north-star metrics (BASELINE.md: p50 TTFT < 200 ms,
+    tokens/sec/chip) for any request class.
+    """
+
+    def __init__(self, name: str, registry: Registry = REGISTRY):
+        self.name = name
+        self.registry = registry
+        self._start = time.monotonic()
+        self._first: Optional[float] = None
+        self._tokens = 0
+        registry.counter(f"{name}_requests_total").inc()
+
+    def token(self, n: int = 1) -> None:
+        if self._first is None:
+            self._first = time.monotonic()
+            self.registry.histogram(f"{self.name}_ttft_seconds").observe(
+                self._first - self._start)
+        self._tokens += n
+
+    def finish(self) -> None:
+        dur = time.monotonic() - self._start
+        self.registry.histogram(f"{self.name}_duration_seconds").observe(dur)
+        if self._tokens and dur > 0:
+            self.registry.counter(f"{self.name}_tokens_total").inc(self._tokens)
+            self.registry.gauge(f"{self.name}_last_tokens_per_second").set(
+                self._tokens / dur)

@@ -1,0 +1,117 @@
+"""OpenTelemetry tracing spine, gated by ``ENABLE_TRACING``.
+
+Parity with the reference's tracing modules:
+- chain-server side extracts W3C traceparent from incoming request headers
+  and wraps handlers in spans (reference: common/tracing.py:51-69);
+- client side injects the current context into outgoing headers
+  (reference: frontend/frontend/tracing.py:47-63).
+
+When tracing is disabled (the default) every helper degrades to a no-op —
+zero overhead, no SDK initialization, same as the reference's
+``if not enabled`` fallthrough wrappers.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from contextlib import contextmanager
+from typing import Any, Optional
+
+_ENABLED = os.environ.get("ENABLE_TRACING", "").lower() in ("1", "true", "yes")
+_tracer = None
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def _get_tracer():
+    """Lazy tracer init (service name 'chain-server' like the reference,
+    common/tracing.py:32-48; OTLP endpoint from the standard env var)."""
+    global _tracer
+    if _tracer is None and _ENABLED:
+        from opentelemetry import trace
+        from opentelemetry.sdk.resources import Resource
+        from opentelemetry.sdk.trace import TracerProvider
+        from opentelemetry.sdk.trace.export import (BatchSpanProcessor,
+                                                    ConsoleSpanExporter)
+
+        service = os.environ.get("OTEL_SERVICE_NAME", "chain-server")
+        provider = TracerProvider(
+            resource=Resource.create({"service.name": service}))
+        endpoint = os.environ.get("OTEL_EXPORTER_OTLP_ENDPOINT")
+        if endpoint:
+            try:
+                from opentelemetry.exporter.otlp.proto.grpc.trace_exporter \
+                    import OTLPSpanExporter
+                provider.add_span_processor(
+                    BatchSpanProcessor(OTLPSpanExporter(endpoint=endpoint)))
+            except ImportError:
+                provider.add_span_processor(
+                    BatchSpanProcessor(ConsoleSpanExporter()))
+        trace.set_tracer_provider(provider)
+        _tracer = trace.get_tracer("generativeaiexamples_tpu")
+    return _tracer
+
+
+@contextmanager
+def server_span(name: str, headers: Optional[dict] = None,
+                attributes: Optional[dict] = None):
+    """Span with remote parent extracted from W3C headers
+    (reference: common/tracing.py:56-58)."""
+    tracer = _get_tracer()
+    if tracer is None:
+        yield None
+        return
+    from opentelemetry import trace
+    from opentelemetry.propagate import extract
+    ctx = extract(dict(headers or {}))
+    with tracer.start_as_current_span(
+            name, context=ctx, kind=trace.SpanKind.SERVER,
+            attributes=attributes or {}) as span:
+        yield span
+
+
+def inject_context(headers: Optional[dict] = None) -> dict:
+    """Inject current trace context into outgoing headers
+    (reference: frontend/tracing.py:47-63)."""
+    headers = dict(headers or {})
+    if _ENABLED:
+        from opentelemetry.propagate import inject
+        inject(headers)
+    return headers
+
+
+def instrumented(name: str):
+    """Decorator for aiohttp handlers: wraps in a server span carrying the
+    request's W3C context (reference: common/tracing.py:51-69
+    ``instrumentation_wrapper``). No-op (identity passthrough of the
+    handler's own behavior) when tracing is off."""
+    def deco(handler):
+        @functools.wraps(handler)
+        async def wrapper(request, *args: Any, **kwargs: Any):
+            if not _ENABLED:
+                return await handler(request, *args, **kwargs)
+            with server_span(name, headers=request.headers,
+                             attributes={"http.route": str(request.rel_url)}):
+                return await handler(request, *args, **kwargs)
+        return wrapper
+    return deco
+
+
+@contextmanager
+def event_span(kind: str, **attributes: Any):
+    """Child span for pipeline events — the first-party replacement for the
+    reference's LlamaIndex callback→OTel bridge
+    (reference: tools/observability/llamaindex/opentelemetry_callback.py:
+    84-197 maps QUERY/RETRIEVE/EMBEDDING/SYNTHESIZE/LLM events to spans).
+    Chains call this directly around retrieve/embed/generate stages."""
+    tracer = _get_tracer()
+    if tracer is None:
+        yield None
+        return
+    clean = {k: v for k, v in attributes.items()
+             if isinstance(v, (str, int, float, bool))}
+    with tracer.start_as_current_span(kind, attributes=clean) as span:
+        yield span

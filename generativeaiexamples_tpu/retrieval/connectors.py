@@ -42,10 +42,11 @@ class MilvusStore(VectorStore):
         self.nprobe = nprobe
         self._client = MilvusClient(uri=url)
         self._collection = collection
-        self._next_id = 0
         if not self._client.has_collection(collection):
+            # auto_id: Milvus assigns primary keys, so reconnecting to an
+            # existing collection can never collide with prior inserts.
             self._client.create_collection(
-                collection_name=collection, dimension=dim,
+                collection_name=collection, dimension=dim, auto_id=True,
                 metric_type="IP" if metric == "ip" else "L2",
                 index_params={"index_type": "IVF_FLAT",
                               "params": {"nlist": nlist}})
@@ -60,11 +61,9 @@ class MilvusStore(VectorStore):
 
     def add(self, embeddings: np.ndarray) -> list[int]:
         emb = _as_2d(embeddings)
-        ids = list(range(self._next_id, self._next_id + emb.shape[0]))
-        self._next_id += emb.shape[0]
-        self._client.insert(self._collection, [
-            {"id": i, "vector": row.tolist()} for i, row in zip(ids, emb)])
-        return ids
+        res = self._client.insert(self._collection, [
+            {"vector": row.tolist()} for row in emb])
+        return [int(i) for i in res["ids"]]
 
     def search(self, queries: np.ndarray, k: int = 4) -> list[list[SearchHit]]:
         q = _as_2d(queries)
@@ -132,7 +131,7 @@ class PgvectorStore(VectorStore):
 
     def search(self, queries: np.ndarray, k: int = 4) -> list[list[SearchHit]]:
         q = _as_2d(queries)
-        op = "<#>" if self.metric == "ip" else "<->"  # negative ip / l2
+        op = "<#>" if self.metric == "ip" else "<->"  # negative ip / l2 dist
         out = []
         with self._conn.cursor() as cur:
             for row in q:
@@ -140,8 +139,17 @@ class PgvectorStore(VectorStore):
                     f"SELECT id, embedding {op} %s::vector AS d "
                     f"FROM {self._table} ORDER BY d LIMIT %s",
                     (row.tolist(), k))
-                out.append([SearchHit(int(i), -float(d))
-                            for i, d in cur.fetchall()])
+                # Match the VectorStore score contract: ip → inner product
+                # (pgvector's <#> is its negation), l2 → negated *squared*
+                # distance (<-> is euclidean), so scores are comparable
+                # across every backend.
+                if self.metric == "ip":
+                    hits = [SearchHit(int(i), -float(d))
+                            for i, d in cur.fetchall()]
+                else:
+                    hits = [SearchHit(int(i), -float(d) ** 2)
+                            for i, d in cur.fetchall()]
+                out.append(hits)
         return out
 
     def delete(self, ids: Sequence[int]) -> None:

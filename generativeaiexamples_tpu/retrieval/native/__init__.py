@@ -58,9 +58,16 @@ def load() -> Optional[ctypes.CDLL]:
                 return None
         try:
             lib = ctypes.CDLL(_LIB)
-        except OSError as exc:
-            logger.info("native topk load failed: %s", exc)
-            return None
+        except OSError:
+            # Stale/foreign-arch binary (e.g. copied between hosts):
+            # rebuild once before giving up.
+            if not _compile():
+                return None
+            try:
+                lib = ctypes.CDLL(_LIB)
+            except OSError as exc:
+                logger.info("native topk load failed: %s", exc)
+                return None
         lib.gaie_brute_topk.argtypes = [
             _f32p, ctypes.c_void_p, ctypes.c_void_p, _i64, _i64,
             _f32p, _i64, _i64, ctypes.c_int, _i64p, _f32p]

@@ -105,3 +105,20 @@ def get_vector_store(name: str = "exact", dim: int = 1024, **kwargs,
         from .connectors import PgvectorStore
         return PgvectorStore(dim=dim, **kwargs)
     raise ValueError(f"unknown vector store {name!r}")
+
+
+def store_from_config(cfg, dim: int) -> VectorStore:
+    """Build a store from a ``VectorStoreConfig`` section, forwarding the
+    backend-relevant knobs (url for remote engines, nlist/nprobe for ANN) —
+    the wiring the reference does inline in ``get_vector_index``
+    (reference: common/utils.py:150-189)."""
+    name = cfg.name.lower()
+    kwargs: dict = {}
+    if name == "ivfflat":
+        kwargs.update(nlist=cfg.nlist, nprobe=cfg.nprobe)
+    elif name in ("milvus", "pgvector"):
+        if cfg.url:
+            kwargs["url"] = cfg.url
+        if name == "milvus":
+            kwargs.update(nlist=cfg.nlist, nprobe=cfg.nprobe)
+    return get_vector_store(name, dim=dim, **kwargs)

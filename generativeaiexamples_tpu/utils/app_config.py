@@ -40,8 +40,8 @@ RAG_TEMPLATE = (
 @dataclass(frozen=True)
 class VectorStoreConfig:
     """Reference: common/configuration.py:20-47."""
-    name: str = configfield("name", default="brute",
-                            help_txt="vector store backend: brute | ivf | native | milvus | pgvector")
+    name: str = configfield("name", default="exact",
+                            help_txt="vector store backend: exact | exact-tpu | ivfflat | milvus | pgvector")
     url: str = configfield("url", default="",
                            help_txt="remote store URL (milvus/pgvector only)")
     nlist: int = configfield("nlist", default=64,

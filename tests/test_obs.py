@@ -1,0 +1,67 @@
+"""Observability tests: metrics registry, request timing, tracing no-ops."""
+
+import time
+
+from generativeaiexamples_tpu.obs.metrics import (Registry, RequestTimer)
+from generativeaiexamples_tpu.obs import tracing
+
+
+def test_counter_and_gauge():
+    reg = Registry()
+    reg.counter("reqs").inc()
+    reg.counter("reqs").inc(2)
+    reg.gauge("temp").set(3.5)
+    snap = reg.snapshot()
+    assert snap["reqs"] == 3
+    assert snap["temp"] == 3.5
+
+
+def test_histogram_percentile_and_render():
+    reg = Registry()
+    h = reg.histogram("lat")
+    for v in [0.01, 0.02, 0.05, 0.1, 0.5]:
+        h.observe(v)
+    assert h.count == 5
+    assert 0.0 < h.percentile(0.5) <= 0.1
+    text = reg.render_prometheus()
+    assert "# TYPE lat histogram" in text
+    assert 'lat_bucket{le="+Inf"} 5' in text
+    assert "lat_count 5" in text
+
+
+def test_request_timer_ttft_and_tps():
+    reg = Registry()
+    t = RequestTimer("gen", registry=reg)
+    time.sleep(0.01)
+    t.token(5)
+    t.token(5)
+    t.finish()
+    snap = reg.snapshot()
+    assert snap["gen_requests_total"] == 1
+    assert snap["gen_ttft_seconds_count"] == 1
+    assert snap["gen_tokens_total"] == 10
+    assert snap["gen_last_tokens_per_second"] > 0
+
+
+def test_tracing_disabled_noops():
+    assert not tracing.enabled()
+    with tracing.server_span("x", headers={"traceparent": "00-abc"}) as span:
+        assert span is None
+    with tracing.event_span("retrieve", top_k=4) as span:
+        assert span is None
+    headers = tracing.inject_context({"a": "b"})
+    assert headers == {"a": "b"}
+
+
+def test_instrumented_passthrough():
+    import asyncio
+
+    @tracing.instrumented("handler")
+    async def handler(request):
+        return "ok"
+
+    class FakeReq:
+        headers = {}
+        rel_url = "/x"
+
+    assert asyncio.new_event_loop().run_until_complete(handler(FakeReq())) == "ok"
