@@ -112,10 +112,12 @@ class OpenAICompatLLM(LLM):
                 "max_tokens": max_tokens, "stream": True,
                 "temperature": temperature, "top_p": top_p,
                 "stop": list(stop or [])}
-        if top_k == 1:
-            # Express greedy via temperature=0 — portable to servers that
+        if top_k == 1 and temperature == 1.0:
+            # Both knobs at their (reference-parity greedy) defaults:
+            # express greedy via temperature=0, portable to servers that
             # reject non-standard arguments (the real OpenAI API 400s on
-            # unknown fields).
+            # unknown fields). An explicit temperature wins over the
+            # top_k default.
             body["temperature"] = 0.0
         elif top_k > 1 and self.send_top_k:
             body["top_k"] = top_k

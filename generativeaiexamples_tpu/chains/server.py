@@ -31,14 +31,13 @@ from typing import Optional
 from aiohttp import web
 
 from ..obs import metrics as obs_metrics
-from ..obs.tracing import instrumented, server_span
+from ..obs.tracing import instrumented
+from ..serving.streaming import iterate_in_thread
 from ..utils.errors import ChainError
 from ..utils.logging import get_logger
 from .base import BaseExample
 
 logger = get_logger(__name__)
-
-_SENTINEL = object()
 
 
 def discover_example(spec: str) -> type[BaseExample]:
@@ -111,51 +110,27 @@ def create_app(example: BaseExample,
                      "Cache-Control": "no-cache"})
         await resp.prepare(request)
 
-        loop = asyncio.get_running_loop()
-        # Unbounded thread-safe queue + cancellation flag: the producer
-        # must never block on a dead consumer (a client disconnect would
-        # otherwise wedge the executor thread forever). Memory stays
-        # bounded by num_tokens.
-        import queue as _queue
-        chunks: "_queue.SimpleQueue" = _queue.SimpleQueue()
-        cancelled = False
-
-        def produce() -> None:
+        def run_chain():
+            """Generator wrapping the chain: per-token metrics + degrade to
+            a user-readable error in-stream (reference: server.py:136-142)."""
             timer = obs_metrics.RequestTimer("chain_generate")
             try:
                 gen = (example.rag_chain(question, num_tokens) if use_kb
                        else example.llm_chain(context, question, num_tokens))
                 for chunk in gen:
-                    if cancelled:
-                        gen.close()
-                        break
-                    timer.token(len(chunk))
-                    chunks.put(chunk)
+                    timer.token(1)
+                    yield chunk
             except Exception as exc:  # noqa: BLE001
                 logger.exception("generation failed")
-                # degrade to a user-readable error in-stream
-                # (reference: server.py:136-142)
-                chunks.put(f"\n[error] {exc}")
+                yield f"\n[error] {exc}"
             finally:
                 timer.finish()
-                chunks.put(_SENTINEL)
 
-        producer = loop.run_in_executor(None, produce)
         try:
-            while True:
-                try:
-                    chunk = chunks.get_nowait()
-                except _queue.Empty:
-                    await asyncio.sleep(0.005)
-                    continue
-                if chunk is _SENTINEL:
-                    break
+            async for chunk in iterate_in_thread(run_chain()):
                 await resp.write(chunk.encode("utf-8"))
         except (ConnectionResetError, ConnectionError):
             logger.info("client disconnected mid-stream")
-        finally:
-            cancelled = True
-            await producer
         await resp.write_eof()
         return resp
 

@@ -164,9 +164,15 @@ class Engine:
         self._stopped = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._fatal: Optional[BaseException] = None
+        self._admitting: Optional[_Request] = None  # req in prefill flight
 
         self.stats = {"requests": 0, "tokens_generated": 0, "decode_steps": 0,
                       "prefills": 0}
+        # Effective prefill buckets, clipped to the prompt limit so a
+        # bucket can never exceed the cache extent.
+        self._buckets = tuple(sorted(
+            {min(b, cfg.max_input_length) for b in cfg.prefill_buckets}
+            | {cfg.max_input_length}))
 
         self._build_jitted()
 
@@ -322,7 +328,7 @@ class Engine:
     # ------------------------------------------------------------ scheduler
 
     def _bucket_for(self, n: int) -> int:
-        for b in self.cfg.prefill_buckets:
+        for b in self._buckets:
             if n <= b:
                 return b
         return self.cfg.max_input_length
@@ -339,6 +345,8 @@ class Engine:
                     self._wake.clear()
         except BaseException as exc:  # noqa: BLE001 - report to all streams
             self._fatal = exc
+            if self._admitting is not None:  # crashed mid-prefill
+                self._admitting.stream._fail(exc)
             for req in list(self._slots.values()):
                 req.stream._fail(exc)
             while not self._pending.empty():
@@ -354,6 +362,7 @@ class Engine:
                 req, sp = self._pending.get_nowait()
             except queue.Empty:
                 break
+            self._admitting = req
             slot = self._free_slots.pop()
             bucket = self._bucket_for(len(req.prompt_ids))
             ids = req.prompt_ids + [0] * (bucket - len(req.prompt_ids))
@@ -372,6 +381,7 @@ class Engine:
                 seen)
             self.stats["prefills"] += 1
             self._slots[slot] = req
+            self._admitting = None
             self._emit(slot, req, int(first_tok))
             admitted = True
             max_prefills -= 1
@@ -409,8 +419,13 @@ class Engine:
 
         if finish is not None:
             if finish in ("eos", "length"):
-                # Emit any text withheld as a potential stop-word prefix.
+                # Emit text still held back — both the detokenizer's
+                # incomplete-fragment window and any potential stop-word
+                # prefix in the stop checker.
+                req.stream._put_chunk(req.stop.feed(req.detok.flush()))
                 req.stream._put_chunk(req.stop.flush())
+                if req.stop.stopped and finish == "length":
+                    finish = "stop"  # stop word surfaced in the final flush
             del self._slots[slot]
             self._free_slots.append(slot)
             self._state = self._release(self._state, jnp.int32(slot))

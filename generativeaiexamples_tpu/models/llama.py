@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.attention import gqa_attention
+from ..ops.quant import matmul as qmm
 from ..ops.rmsnorm import rmsnorm
 from ..ops.rope import apply_rope, rope_frequencies
 from .configs import LlamaConfig
@@ -97,8 +98,8 @@ def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int,
 
 
 def _dense_mlp(x: jax.Array, lp: dict[str, jax.Array]) -> jax.Array:
-    gate = jax.nn.silu(x @ lp["w_gate"])
-    return (gate * (x @ lp["w_up"])) @ lp["w_down"]
+    gate = jax.nn.silu(qmm(x, lp["w_gate"]))
+    return qmm(gate * qmm(x, lp["w_up"]), lp["w_down"])
 
 
 def _moe_mlp(x: jax.Array, lp: dict[str, jax.Array], cfg: LlamaConfig) -> jax.Array:
@@ -144,13 +145,13 @@ def apply(params: Params, cfg: LlamaConfig, tokens: jax.Array,
         kv_valid_len = positions[:, -1] + 1
 
     def qkv(x: jax.Array, lp: dict[str, jax.Array]):
-        q = (x @ lp["wq"]).reshape(B, S, cfg.num_heads, cfg.head_dim)
-        k = (x @ lp["wk"]).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
-        v = (x @ lp["wv"]).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
+        q = qmm(x, lp["wq"]).reshape(B, S, cfg.num_heads, cfg.head_dim)
+        k = qmm(x, lp["wk"]).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
+        v = qmm(x, lp["wv"]).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
         return apply_rope(q, k, positions, inv_freq) + (v,)
 
     def finish_layer(h: jax.Array, attn: jax.Array, lp: dict[str, jax.Array]):
-        h = h + attn.reshape(B, S, cfg.q_dim) @ lp["wo"]
+        h = h + qmm(attn.reshape(B, S, cfg.q_dim), lp["wo"])
         x = rmsnorm(h, lp["mlp_norm"], cfg.rms_norm_eps)
         mlp = _moe_mlp(x, lp, cfg) if cfg.num_experts else _dense_mlp(x, lp)
         return h + mlp
@@ -186,6 +187,7 @@ def apply(params: Params, cfg: LlamaConfig, tokens: jax.Array,
         return h, new_cache
     head = params.get("lm_head")
     if head is None:
-        head = params["embed"].T
-    logits = (h.astype(jnp.float32) @ head.astype(jnp.float32))
+        return h.astype(jnp.float32) @ params["embed"].T.astype(jnp.float32), \
+            new_cache
+    logits = qmm(h.astype(jnp.float32), head)
     return logits, new_cache

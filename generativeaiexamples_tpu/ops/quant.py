@@ -1,0 +1,115 @@
+"""Weight-only quantization: symmetric per-channel int8 / packed int4.
+
+Parity point: the reference offers int4-AWQ / int8 weight-only engines
+(reference: conversion/llama.py:81-97 ``--quantization int4_awq``,
+conversion_scripts/llama/build.py:543-580 QuantMode wiring). TPU-idiomatic
+version: weights live in HBM as int8 (int4 packed two-per-byte), and XLA
+fuses the dequantize (cast + scale) into the matmul prologue — the MXU
+still sees bf16 operands, but HBM traffic and footprint drop 2-4x, which
+is what matters for weight-bound decode.
+
+A quantized tensor is a dict leaf:
+  int8: ``{"q":  int8[..., K, N],   "scale": f32[..., N]}``
+  int4: ``{"q4": int8[..., K/2, N], "scale": f32[..., N]}``  (two nibbles
+         per byte along the reduction axis, low nibble = even k)
+Every leaf is an array and weight rank is preserved, so one PartitionSpec
+tree serves raw and quantized params alike.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Union
+
+import jax
+import jax.numpy as jnp
+
+QTensor = dict[str, jax.Array]
+
+# Weights quantized by quantize_params; norms/embeddings stay high precision
+# (embed doubles as the tied lm_head input and is gather-bound, not
+# matmul-bound).
+_QUANT_LAYER_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def is_quantized(w: Any) -> bool:
+    return isinstance(w, dict) and "scale" in w and ("q" in w or "q4" in w)
+
+
+def quantize_tensor(w: jax.Array, bits: int = 8) -> QTensor:
+    """Symmetric per-output-channel quantization over the reduction axis.
+
+    w: (..., K, N) float → q in [-127,127] (int8) or [-7,7] (int4) with
+    ``q * scale ≈ w``.
+    """
+    if bits not in (4, 8):
+        raise ValueError(f"bits must be 4 or 8, got {bits}")
+    wf = w.astype(jnp.float32)
+    qmax = 127.0 if bits == 8 else 7.0
+    absmax = jnp.max(jnp.abs(wf), axis=-2)              # (..., N)
+    scale = jnp.maximum(absmax / qmax, 1e-12)
+    q = jnp.clip(jnp.round(wf / scale[..., None, :]), -qmax, qmax
+                 ).astype(jnp.int8)
+    if bits == 4:
+        K = q.shape[-2]
+        if K % 2:
+            raise ValueError(f"int4 needs even reduction dim, got {K}")
+        packed = ((q[..., 0::2, :] & 0x0F) | (q[..., 1::2, :] << 4)
+                  ).astype(jnp.int8)
+        return {"q4": packed, "scale": scale.astype(jnp.float32)}
+    return {"q": q, "scale": scale.astype(jnp.float32)}
+
+
+def _unpack4(q4: jax.Array) -> jax.Array:
+    """(..., K/2, N) packed nibbles → (..., K, N) int8."""
+    lo = (q4 << 4).astype(jnp.int8) >> 4     # sign-extend low nibble
+    hi = q4 >> 4                              # arithmetic shift: high nibble
+    out = jnp.stack([lo, hi], axis=-2)        # (..., K/2, 2, N)
+    return out.reshape(*q4.shape[:-2], q4.shape[-2] * 2, q4.shape[-1])
+
+
+def _int_weights(w: QTensor) -> jax.Array:
+    return _unpack4(w["q4"]) if "q4" in w else w["q"]
+
+
+def dequantize(w: QTensor, dtype=jnp.bfloat16) -> jax.Array:
+    q = _int_weights(w)
+    return (q.astype(jnp.float32) * w["scale"][..., None, :]).astype(dtype)
+
+
+def matmul(x: jax.Array, w: Union[jax.Array, QTensor]) -> jax.Array:
+    """``x @ w`` where w may be raw or quantized.
+
+    Dequant happens inline — XLA fuses the widen into the dot's operand
+    read, so no full-precision copy of w is materialized. The per-channel
+    scale is applied after the matmul (mathematically identical, one
+    multiply per output element instead of per weight).
+    """
+    if not is_quantized(w):
+        return x @ w
+    q = _int_weights(w)
+    y = jax.lax.dot_general(
+        x, q.astype(x.dtype),
+        (((x.ndim - 1,), (q.ndim - 2,)), ((), ())))
+    return y * w["scale"].astype(x.dtype)
+
+
+def quantize_params(params: Any, mode: str = "int8") -> Any:
+    """Quantize a llama param tree's matmul weights in place of the raw
+    arrays. ``mode``: int8 | int4 | int4_awq (AWQ-format checkpoints load
+    pre-scaled via their importer; applying int4_awq to raw weights falls
+    back to plain int4)."""
+    bits = {"int8": 8, "int4": 4, "int4_awq": 4}.get(mode)
+    if bits is None:
+        raise ValueError(f"unknown quantization mode {mode!r}")
+    out = dict(params)
+    layers = dict(params["layers"])
+    for key in _QUANT_LAYER_KEYS:
+        # MoE expert tensors (L,E,K,N) keep full precision for now — the
+        # expert einsums contract differently than plain matmul.
+        if (key in layers and not is_quantized(layers[key])
+                and layers[key].ndim <= 3):
+            layers[key] = quantize_tensor(layers[key], bits)
+    out["layers"] = layers
+    if "lm_head" in out and not is_quantized(out["lm_head"]):
+        out["lm_head"] = quantize_tensor(out["lm_head"], bits)
+    return out

@@ -96,8 +96,27 @@ def activation_spec(mesh: Mesh) -> P:
 
 
 def shard_params(params: Any, mesh: Mesh, specs: Any) -> Any:
-    """Place a param tree onto the mesh per its specs."""
-    return jax.tree.map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-        params, specs,
-        is_leaf=lambda x: not isinstance(x, dict))
+    """Place a param tree onto the mesh per its specs.
+
+    Quantized leaves (``{"q"|"q4", "scale"}`` dicts from ops.quant) reuse
+    the raw weight's spec: the int tensor takes it verbatim; the
+    per-output-channel scale (one rank lower, reduction axis gone) takes
+    the spec minus its second-to-last axis.
+    """
+    from ..ops.quant import is_quantized
+
+    def place(x, s):
+        return jax.device_put(x, NamedSharding(mesh, s))
+
+    def walk(p: Any, s: Any) -> Any:
+        if isinstance(p, dict):
+            if is_quantized(p):
+                w_spec = tuple(s)
+                scale_spec = (P(*(w_spec[:-2] + w_spec[-1:]))
+                              if len(w_spec) >= 2 else P())
+                return {k: place(v, s if k in ("q", "q4") else scale_spec)
+                        for k, v in p.items()}
+            return {k: walk(v, s[k]) for k, v in p.items()}
+        return place(p, s)
+
+    return walk(params, specs)

@@ -1,0 +1,22 @@
+"""Model serving: HTTP APIs over the continuous-batching engine.
+
+The replacement for the reference's L3 serving stack — Triton ensemble +
+TRT-LLM backend + model_server orchestrator (reference:
+RetrievalAugmentedGeneration/llm-inference-server/). Three pieces:
+
+- ``openai_api``    OpenAI-style ``/v1/completions`` + ``/v1/chat/completions``
+                    + ``/v1/embeddings`` (parity with the nemo-infer
+                    connectors, reference: integrations/langchain/llms/
+                    nemo_infer.py, embeddings/nemo_embed.py).
+- ``triton_shim``   Triton-compatible ``/v2/models/{m}/generate[_stream]``
+                    with the ensemble's tensor names, ready-polling
+                    endpoints included (reference: ensemble_models/llama/
+                    ensemble/config.pbtxt:27-117, trt_llm.py:259-271).
+- ``model_server``  The CLI orchestrator: device discovery, TP×PP topology,
+                    checkpoint sniffing, engine build, server launch
+                    (reference: model_server/__main__.py + __init__.py).
+"""
+
+from .model_server import build_services, create_server_app
+
+__all__ = ["build_services", "create_server_app"]

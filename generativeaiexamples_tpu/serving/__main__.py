@@ -1,0 +1,7 @@
+"""``python -m generativeaiexamples_tpu.serving TYPE ...`` — CLI parity
+with the reference's ``python -m model_server TYPE ...``
+(reference: model_server/__main__.py)."""
+
+from .model_server import main
+
+main()

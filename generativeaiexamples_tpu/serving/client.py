@@ -1,0 +1,132 @@
+"""Client library for the serving APIs.
+
+Parity with the reference's Triton client stack
+(reference: model_server_client/trt_llm.py and its published twin
+integrations/langchain/llms/triton_trt_llm.py): model-ready polling
+(trt_llm.py:259-271), single-shot and streaming generation with the
+ensemble tensor names (trt_llm.py:344-355), stop-word semantics — over the
+shim's HTTP generate extension instead of Triton gRPC. Also a plain
+OpenAI-style client for ``/v1/*`` (the nemo-infer connector equivalent,
+reference: integrations/langchain/llms/nemo_infer.py).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Iterator, Optional
+
+import requests
+
+from ..utils.errors import FrameworkError
+
+
+class ServerNotReadyError(FrameworkError):
+    pass
+
+
+class TritonShimClient:
+    """HTTP client speaking the Triton generate-extension dialect."""
+
+    def __init__(self, server_url: str, model_name: str = "ensemble",
+                 timeout: float = 120.0):
+        self.base = server_url.rstrip("/")
+        self.model_name = model_name
+        self.timeout = timeout
+
+    # parity: load_model readiness polling (trt_llm.py:259-271)
+    def wait_ready(self, timeout: float = 60.0, interval: float = 0.5) -> None:
+        deadline = time.monotonic() + timeout
+        url = f"{self.base}/v2/models/{self.model_name}/ready"
+        last_err: Optional[str] = None
+        while time.monotonic() < deadline:
+            try:
+                resp = requests.get(url, timeout=5)
+                if resp.ok:
+                    return
+                last_err = f"HTTP {resp.status_code}"
+            except requests.RequestException as exc:
+                last_err = str(exc)
+            time.sleep(interval)
+        raise ServerNotReadyError(
+            f"model {self.model_name} not ready after {timeout}s: {last_err}")
+
+    def _body(self, prompt: str, max_tokens: int, temperature: float,
+              top_k: int, top_p: float, repetition_penalty: float,
+              random_seed: int, stop_words: Optional[list[str]]) -> dict:
+        # the ensemble tensor names (config.pbtxt:27-117)
+        return {"text_input": prompt, "max_tokens": max_tokens,
+                "temperature": temperature, "top_k": top_k, "top_p": top_p,
+                "repetition_penalty": repetition_penalty,
+                "random_seed": random_seed, "beam_width": 1,
+                "stop_words": stop_words or []}
+
+    def generate(self, prompt: str, max_tokens: int = 100,
+                 temperature: float = 1.0, top_k: int = 1,
+                 top_p: float = 0.0, repetition_penalty: float = 1.0,
+                 random_seed: int = 0,
+                 stop_words: Optional[list[str]] = None) -> str:
+        resp = requests.post(
+            f"{self.base}/v2/models/{self.model_name}/generate",
+            json=self._body(prompt, max_tokens, temperature, top_k, top_p,
+                            repetition_penalty, random_seed, stop_words),
+            timeout=self.timeout)
+        resp.raise_for_status()
+        return resp.json()["text_output"]
+
+    def generate_stream(self, prompt: str, max_tokens: int = 100,
+                        temperature: float = 1.0, top_k: int = 1,
+                        top_p: float = 0.0, repetition_penalty: float = 1.0,
+                        random_seed: int = 0,
+                        stop_words: Optional[list[str]] = None,
+                        ) -> Iterator[str]:
+        """Yield text deltas until the final-response flag
+        (parity: the decoupled stream callback checks
+        ``triton_final_response``, trt_llm.py:417-442)."""
+        with requests.post(
+                f"{self.base}/v2/models/{self.model_name}/generate_stream",
+                json=self._body(prompt, max_tokens, temperature, top_k,
+                                top_p, repetition_penalty, random_seed,
+                                stop_words),
+                stream=True, timeout=self.timeout) as resp:
+            resp.raise_for_status()
+            for line in resp.iter_lines(decode_unicode=True):
+                if not line or not line.startswith("data:"):
+                    continue
+                payload = json.loads(line[len("data:"):].strip())
+                if payload.get("text_output"):
+                    yield payload["text_output"]
+                if payload.get("triton_final_response"):
+                    return
+
+
+class OpenAIClient:
+    """Thin client for the /v1 surface (completions + embeddings)."""
+
+    def __init__(self, server_url: str, model: str = "default",
+                 timeout: float = 120.0):
+        self.base = server_url.rstrip("/")
+        self.model = model
+        self.timeout = timeout
+
+    def complete(self, prompt: str, **kw) -> str:
+        body = {"model": self.model, "prompt": prompt, **kw}
+        resp = requests.post(f"{self.base}/v1/completions", json=body,
+                             timeout=self.timeout)
+        resp.raise_for_status()
+        return resp.json()["choices"][0]["text"]
+
+    def chat(self, messages: list[dict], **kw) -> str:
+        body = {"model": self.model, "messages": messages, **kw}
+        resp = requests.post(f"{self.base}/v1/chat/completions", json=body,
+                             timeout=self.timeout)
+        resp.raise_for_status()
+        return resp.json()["choices"][0]["message"]["content"]
+
+    def embed(self, texts: list[str], input_type: str = "query") -> list[list[float]]:
+        resp = requests.post(
+            f"{self.base}/v1/embeddings",
+            json={"input": texts, "input_type": input_type},
+            timeout=self.timeout)
+        resp.raise_for_status()
+        return [d["embedding"] for d in resp.json()["data"]]

@@ -1,0 +1,245 @@
+"""Model-server orchestrator: topology, checkpoint, engine, HTTP serving.
+
+Parity with the reference's model_server package (reference:
+llm-inference-server/model_server/):
+- device discovery — ``jax.devices()`` replaces the nvidia-smi probe
+  (reference: model_server/model.py:111-138);
+- TP×PP = world-size defaulting and validation
+  (reference: model_server/__init__.py:103-110);
+- checkpoint format sniffing (reference: model.py:147-173);
+- content-hash gated rebuild — here the hash keys the XLA compilation
+  cache dir, replacing the ``trt-w{ws}-cc{cc}`` engine cache
+  (reference: model.py:33-62, 140-145);
+- then serve — one process, no mpirun: XLA collectives over ICI replace
+  the per-rank Triton processes (reference: server.py:78-101).
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+from aiohttp import web
+
+from ..obs import metrics as obs_metrics
+from ..utils.errors import ConfigError
+from ..utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+MODEL_TYPES = ("llama", "codellama", "gptnext", "mixtral", "dev")
+
+_TYPE_DEFAULT_NAME = {
+    "llama": "llama-2-7b-chat",
+    "codellama": "codellama-13b-instruct",
+    "gptnext": "llama-2-7b-chat",   # GPT-next geometry served via registry name
+    "mixtral": "mixtral-8x7b-instruct",
+    "dev": "llama-tiny",
+}
+
+
+def fast_hash_dir(path: str, workers: int = 8) -> str:
+    """Parallel content hash of a model directory.
+
+    Parity with the reference's parallel-sha1 dir hash that gates engine
+    rebuilds (reference: model_server/model.py:33-62 ``_fast_hash_dir``).
+    """
+    files = []
+    for root, _, names in os.walk(path):
+        for n in sorted(names):
+            files.append(os.path.join(root, n))
+    files.sort()
+
+    def hash_one(p: str) -> str:
+        h = hashlib.sha1()
+        with open(p, "rb") as f:
+            for block in iter(lambda: f.read(1 << 20), b""):
+                h.update(block)
+        return h.hexdigest()
+
+    top = hashlib.sha1()
+    with ThreadPoolExecutor(max_workers=workers) as pool:
+        for p, digest in zip(files, pool.map(hash_one, files)):
+            top.update(os.path.relpath(p, path).encode())
+            top.update(digest.encode())
+    return top.hexdigest()
+
+
+def resolve_topology(world_size: int = 0, tp: int = 0, pp: int = 1,
+                     available: Optional[int] = None) -> tuple[int, int, int]:
+    """(world, tp, pp) with the reference's defaulting rules
+    (reference: model_server/__init__.py:103-110: tp defaults to world/pp,
+    and TP·PP must equal world size)."""
+    import jax
+    if available is None:
+        available = len(jax.devices())
+    world = world_size or available
+    if world > available:
+        raise ConfigError(
+            f"world size {world} exceeds available devices {available}")
+    tp = tp or max(1, world // pp)
+    if tp * pp != world:
+        raise ConfigError(
+            f"tensor parallelism ({tp}) x pipeline parallelism ({pp}) "
+            f"must equal world size ({world})")
+    return world, tp, pp
+
+
+def setup_compile_cache(model_dir: Optional[str], world: int) -> str:
+    """Content-addressed XLA compilation cache.
+
+    The cache dir is keyed by world size + platform the way the reference
+    keys engines by world-size + compute capability
+    (reference: model.py:140-145 ``trt-w{ws}-cc{cc}``). Enabled for
+    accelerator backends only: XLA:CPU AOT results encode exact host
+    machine features, so a persistent CPU cache poisons runs on any other
+    host (set GAIE_COMPILE_CACHE=1 to force).
+    """
+    import jax
+    platform = jax.devices()[0].platform
+    if platform == "cpu" and not os.environ.get("GAIE_COMPILE_CACHE"):
+        return ""
+    base = (os.environ.get("GAIE_CACHE_DIR") or model_dir
+            or os.path.join("/tmp", "generativeaiexamples_tpu"))
+    cache_dir = os.path.join(base, f"xla-w{world}-{platform}")
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    return cache_dir
+
+
+def build_services(model_type: str = "dev", model_name: str = "",
+                   model_path: str = "", embedder_path: str = "",
+                   world_size: int = 0, tp: int = 0, pp: int = 1,
+                   max_input_length: int = 3000, max_output_length: int = 512,
+                   max_slots: int = 8, dtype: str = "bfloat16",
+                   quantization: str = "", with_embedder: bool = True,
+                   seed: int = 0):
+    """Create (engine, embed_service, model_name) per the CLI/config."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..embed.encoder import get_embedder
+    from ..engine.engine import Engine, EngineConfig
+    from ..models import llama
+    from ..models.configs import get_model_config
+    from ..models.import_hf import detect_checkpoint_format, load_checkpoint
+    from ..models.tokenizer import ByteTokenizer, get_tokenizer
+    from ..parallel.mesh import MeshPlan, make_mesh
+
+    if model_type not in MODEL_TYPES:
+        raise ConfigError(
+            f"unknown model type {model_type!r}; known: {MODEL_TYPES}")
+    model_name = model_name or _TYPE_DEFAULT_NAME[model_type]
+    cfg = get_model_config(model_name)
+
+    world, tp, pp = resolve_topology(world_size, tp, pp)
+    mesh = make_mesh(MeshPlan(tp=tp, pp=pp), jax.devices()[:world]) \
+        if world > 1 else None
+    setup_compile_cache(model_path or None, world)
+
+    if model_type == "dev":
+        # Random-init tiny model: air-gapped dev/e2e mode (the 'fake
+        # engine' SURVEY.md §4 notes the reference never shipped).
+        if dtype == "bfloat16":
+            dtype = "float32"  # tiny dev model runs anywhere, incl CPU
+        params = llama.init_params(cfg, jax.random.key(seed),
+                                   dtype=jnp.dtype(dtype))
+        tokenizer = ByteTokenizer()
+    else:
+        if not model_path:
+            raise ConfigError(f"--model-path is required for {model_type}")
+        fmt = detect_checkpoint_format(model_path)
+        logger.info("model format: %s", fmt)
+        params = load_checkpoint(model_path, cfg, dtype=jnp.dtype(dtype))
+        tokenizer = get_tokenizer(model_path)
+
+    if quantization:
+        from ..ops.quant import quantize_params
+        params = quantize_params(params, mode=quantization)
+
+    engine_cfg = EngineConfig(
+        max_slots=max_slots, max_input_length=max_input_length,
+        max_output_length=max_output_length, dtype=dtype, seed=seed)
+    engine = Engine(params, cfg, tokenizer, engine_cfg, mesh=mesh)
+
+    embed_service = None
+    if with_embedder:
+        if embedder_path:
+            embed_service = get_embedder("tpu-jax", "e5-large-v2",
+                                         checkpoint_path=embedder_path)
+        elif model_type == "dev":
+            embed_service = get_embedder("tpu-jax", "encoder-tiny")
+    return engine, embed_service, model_name
+
+
+def create_server_app(engine, embed_service=None,
+                      model_name: str = "model") -> web.Application:
+    """One app serving both API surfaces + health/metrics."""
+    from .openai_api import add_openai_routes
+    from .triton_shim import add_triton_routes
+
+    app = web.Application()
+
+    async def health(request: web.Request) -> web.Response:
+        return web.json_response(
+            {"status": "ok", "model": model_name,
+             "engine": dict(engine.stats)})
+
+    async def metrics_endpoint(request: web.Request) -> web.Response:
+        return web.Response(text=obs_metrics.REGISTRY.render_prometheus(),
+                            content_type="text/plain")
+
+    app.router.add_get("/health", health)
+    app.router.add_get("/metrics", metrics_endpoint)
+    add_openai_routes(app, engine, model_name, embed_service=embed_service,
+                      max_output=engine.cfg.max_output_length)
+    add_triton_routes(app, engine, model_name,
+                      max_output=engine.cfg.max_output_length)
+    return app
+
+
+def main(argv: Optional[list[str]] = None) -> None:
+    """CLI parity with ``python -m model_server TYPE ...``
+    (reference: model_server/__main__.py:33-135)."""
+    parser = argparse.ArgumentParser(
+        description="TPU-native LLM inference server")
+    parser.add_argument("model_type", choices=MODEL_TYPES)
+    parser.add_argument("--model-name", default="")
+    parser.add_argument("--model-path", default=os.environ.get("MODEL_PATH", ""))
+    parser.add_argument("--embedder-path", default="")
+    parser.add_argument("--world-size", type=int, default=0,
+                        help="devices to use (default: all local)")
+    parser.add_argument("--tensor-parallelism", type=int, default=0)
+    parser.add_argument("--pipeline-parallelism", type=int, default=1)
+    parser.add_argument("--quantization", default="",
+                        choices=["", "int8", "int4", "int4_awq"])
+    parser.add_argument("--max-input-length", type=int, default=3000)
+    parser.add_argument("--max-output-length", type=int, default=512)
+    parser.add_argument("--max-batch-size", type=int, default=8)
+    parser.add_argument("--dtype", default="bfloat16")
+    parser.add_argument("--no-embedder", action="store_true")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8000)
+    args = parser.parse_args(argv)
+
+    engine, embed_service, model_name = build_services(
+        model_type=args.model_type, model_name=args.model_name,
+        model_path=args.model_path, embedder_path=args.embedder_path,
+        world_size=args.world_size, tp=args.tensor_parallelism,
+        pp=args.pipeline_parallelism, quantization=args.quantization,
+        max_input_length=args.max_input_length,
+        max_output_length=args.max_output_length,
+        max_slots=args.max_batch_size, dtype=args.dtype,
+        with_embedder=not args.no_embedder)
+    engine.start()
+    logger.info("serving %s on %s:%d", model_name, args.host, args.port)
+    web.run_app(create_server_app(engine, embed_service, model_name),
+                host=args.host, port=args.port)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,189 @@
+"""OpenAI-style HTTP API over the engine + embedder.
+
+Endpoint parity with the reference's NeMo Inference MS connector targets
+(reference: integrations/langchain/llms/nemo_infer.py — ``/v1/completions``
+with SSE streaming; embeddings/nemo_embed.py — ``/v1/embeddings`` with
+``input_type`` passage/query), plus ``/v1/chat/completions`` and
+``/v1/models``. Unlike nemo's cumulative-text SSE (client must diff,
+nemo_infer.py:141-156), streams send true deltas.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from typing import Optional
+
+from aiohttp import web
+
+from ..engine.sampling_params import SamplingParams
+from ..obs import metrics as obs_metrics
+from ..obs.tracing import instrumented
+from .streaming import iterate_in_thread
+
+
+def _sampling_from_body(body: dict, max_output: int) -> SamplingParams:
+    max_tokens = min(int(body.get("max_tokens", 256)), max_output)
+    temperature = float(body.get("temperature", 1.0))
+    stop = body.get("stop") or []
+    if isinstance(stop, str):  # OpenAI allows a bare string
+        stop = [stop]
+    return SamplingParams(
+        max_tokens=max_tokens,
+        temperature=temperature,
+        # OpenAI semantics: temperature/top_p drive sampling; top_k
+        # unlimited unless the caller uses our extension. (The Triton shim
+        # keeps the reference's greedy top_k=1 default instead.)
+        top_k=int(body.get("top_k", 0)),
+        top_p=float(body.get("top_p", 1.0)),
+        repetition_penalty=float(body.get("repetition_penalty", 1.0)),
+        random_seed=int(body.get("seed", body.get("random_seed", 0))),
+        stop_words=[str(s) for s in stop],
+    )
+
+
+def _completion_payload(rid: str, model: str, text: str,
+                        finish: Optional[str], *, kind: str,
+                        created: int, usage: Optional[dict] = None,
+                        stream_delta: bool = False) -> dict:
+    if kind == "chat":
+        if stream_delta:
+            choice: dict = {"index": 0, "delta": {"content": text},
+                            "finish_reason": finish}
+        else:
+            choice = {"index": 0,
+                      "message": {"role": "assistant", "content": text},
+                      "finish_reason": finish}
+        obj = "chat.completion.chunk" if stream_delta else "chat.completion"
+    else:
+        choice = {"index": 0, "text": text, "finish_reason": finish}
+        obj = "text_completion"
+    out = {"id": rid, "object": obj, "created": created, "model": model,
+           "choices": [choice]}
+    if usage:
+        out["usage"] = usage
+    return out
+
+
+def add_openai_routes(app: web.Application, engine, model_name: str,
+                      embed_service=None, chat_template: Optional[str] = None,
+                      max_output: int = 512) -> None:
+    """Mount /v1/* routes for one engine (and optional embedder)."""
+
+    def render_chat(messages: list[dict]) -> str:
+        """Llama-2 [INST] chat rendering (parity with the reference's
+        prompt templates, common/configuration.py:124-156)."""
+        system = ""
+        turns: list[str] = []
+        for m in messages:
+            role, content = m.get("role"), m.get("content", "")
+            if role == "system":
+                system = f"<<SYS>>\n{content}\n<</SYS>>\n\n"
+            elif role == "user":
+                turns.append(f"<s>[INST] {system}{content} [/INST]")
+                system = ""
+            elif role == "assistant":
+                turns.append(f" {content} </s>")
+        return "".join(turns)
+
+    async def _generate(request: web.Request, kind: str) -> web.StreamResponse:
+        body = await request.json()
+        if kind == "chat":
+            prompt = render_chat(body.get("messages", []))
+        else:
+            prompt = body.get("prompt", "")
+            if isinstance(prompt, list):
+                prompt = prompt[0] if prompt else ""
+        if not prompt:
+            raise web.HTTPUnprocessableEntity(
+                text="empty prompt/messages")
+        params = _sampling_from_body(body, max_output)
+        rid = f"cmpl-{uuid.uuid4().hex[:24]}"
+        created = int(time.time())
+        timer = obs_metrics.RequestTimer(f"serve_{kind}")
+
+        engine.start()
+        try:
+            stream = engine.stream_text(prompt, params)
+        except Exception as exc:  # noqa: BLE001
+            raise web.HTTPServiceUnavailable(text=str(exc)) from exc
+
+        if body.get("stream"):
+            resp = web.StreamResponse(
+                headers={"Content-Type": "text/event-stream",
+                         "Cache-Control": "no-cache"})
+            await resp.prepare(request)
+            async for chunk in iterate_in_thread(iter(stream)):
+                # each emitted chunk ≈ one decode step (one token)
+                timer.token(1)
+                payload = _completion_payload(
+                    rid, model_name, chunk, None, kind=kind,
+                    created=created, stream_delta=True)
+                await resp.write(f"data: {json.dumps(payload)}\n\n".encode())
+            final = _completion_payload(rid, model_name, "",
+                                        stream.finish_reason, kind=kind,
+                                        created=created, stream_delta=True)
+            await resp.write(f"data: {json.dumps(final)}\n\n".encode())
+            await resp.write(b"data: [DONE]\n\n")
+            timer.finish()
+            await resp.write_eof()
+            return resp
+
+        text = "".join([c async for c in iterate_in_thread(iter(stream))])
+        timer.token(len(stream.token_ids))
+        timer.finish()
+        n_prompt = len(engine.tokenizer.encode(prompt))
+        usage = {"prompt_tokens": n_prompt,
+                 "completion_tokens": len(stream.token_ids),
+                 "total_tokens": n_prompt + len(stream.token_ids)}
+        return web.json_response(_completion_payload(
+            rid, model_name, text, stream.finish_reason, kind=kind,
+            created=created, usage=usage))
+
+    @instrumented("v1_completions")
+    async def completions(request: web.Request) -> web.StreamResponse:
+        return await _generate(request, "completion")
+
+    @instrumented("v1_chat_completions")
+    async def chat_completions(request: web.Request) -> web.StreamResponse:
+        return await _generate(request, "chat")
+
+    @instrumented("v1_embeddings")
+    async def embeddings(request: web.Request) -> web.Response:
+        if embed_service is None:
+            raise web.HTTPNotImplemented(text="no embedding model loaded")
+        body = await request.json()
+        inputs = body.get("input", [])
+        if isinstance(inputs, str):
+            inputs = [inputs]
+        # input_type parity with the NeMo retriever API
+        # (reference: embeddings/nemo_embed.py:96-102).
+        input_type = body.get("input_type", "query")
+        import asyncio
+        loop = asyncio.get_running_loop()
+        if input_type == "passage":
+            vecs = await loop.run_in_executor(
+                None, embed_service.embed_documents, inputs)
+        else:
+            vecs = await loop.run_in_executor(
+                None, lambda: [embed_service.embed_query(t) for t in inputs])
+        data = [{"object": "embedding", "index": i,
+                 "embedding": [float(x) for x in v]}
+                for i, v in enumerate(vecs)]
+        return web.json_response(
+            {"object": "list", "data": data,
+             "model": body.get("model", "e5-large-v2")})
+
+    async def models(request: web.Request) -> web.Response:
+        entries = [{"id": model_name, "object": "model",
+                    "owned_by": "generativeaiexamples-tpu"}]
+        if embed_service is not None:
+            entries.append({"id": "embeddings", "object": "model",
+                            "owned_by": "generativeaiexamples-tpu"})
+        return web.json_response({"object": "list", "data": entries})
+
+    app.router.add_post("/v1/completions", completions)
+    app.router.add_post("/v1/chat/completions", chat_completions)
+    app.router.add_post("/v1/embeddings", embeddings)
+    app.router.add_get("/v1/models", models)

@@ -1,0 +1,47 @@
+"""Bridging the engine's thread-world streams into asyncio responses."""
+
+from __future__ import annotations
+
+import asyncio
+import queue as _queue
+from typing import AsyncIterator, Iterator
+
+_SENTINEL = object()
+
+
+async def iterate_in_thread(it: Iterator[str]) -> AsyncIterator[str]:
+    """Drive a blocking iterator on the default executor, yielding into the
+    event loop. Never lets the producer block on a dead consumer (client
+    disconnects propagate as cancellation; the producer thread drains out).
+    """
+    loop = asyncio.get_running_loop()
+    q: "_queue.SimpleQueue" = _queue.SimpleQueue()
+    done = False
+
+    def produce() -> None:
+        try:
+            for chunk in it:
+                if done:
+                    break
+                q.put(chunk)
+        except BaseException as exc:  # noqa: BLE001 — surface in consumer
+            q.put(exc)
+        finally:
+            q.put(_SENTINEL)
+
+    producer = loop.run_in_executor(None, produce)
+    try:
+        while True:
+            try:
+                item = q.get_nowait()
+            except _queue.Empty:
+                await asyncio.sleep(0.002)
+                continue
+            if item is _SENTINEL:
+                break
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        done = True
+        await producer

@@ -1,0 +1,141 @@
+"""Triton-compatible HTTP shim: the ensemble tensor API, minus Triton.
+
+The reference's public serving surface is the Triton ensemble tensor API —
+``text_input``, ``max_tokens``, ``top_k``, ``top_p``, ``temperature``,
+``length_penalty``, ``repetition_penalty``, ``random_seed``, ``beam_width``,
+``stream``, ``stop_words``, ``bad_words`` in, ``text_output`` out
+(reference: ensemble_models/llama/ensemble/config.pbtxt:27-117; the client
+builds exactly this input list, model_server_client/trt_llm.py:344-355).
+
+This shim keeps those names and semantics over Triton's standard HTTP
+generate extension (``/v2/models/{model}/generate`` and
+``/generate_stream``) plus the health/ready endpoints the reference's
+client polls (reference: trt_llm.py:259-271 ``load_model`` waits on model
+readiness), so existing Triton-generate clients can point at the TPU stack
+unchanged.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from aiohttp import web
+
+from ..engine.sampling_params import SamplingParams
+from ..obs import metrics as obs_metrics
+from ..obs.tracing import instrumented
+from .streaming import iterate_in_thread
+
+
+def _first(v: Any) -> Any:
+    """Triton clients send scalars as [v] or [[v]]; unwrap."""
+    while isinstance(v, (list, tuple)) and v:
+        v = v[0]
+    return v
+
+
+def _params_from_triton(body: dict, max_output: int) -> SamplingParams:
+    def get(name: str, default, cast):
+        v = body.get(name)
+        return cast(_first(v)) if v is not None else default
+
+    stop_words = body.get("stop_words") or []
+    if isinstance(stop_words, str):
+        stop_words = [stop_words]
+    stop_words = [str(s) for s in stop_words if s]
+    beam = get("beam_width", 1, int)
+    if beam != 1:
+        raise web.HTTPBadRequest(text="beam_width != 1 is not supported")
+    return SamplingParams(
+        max_tokens=min(get("max_tokens", 100, int), max_output),
+        temperature=get("temperature", 1.0, float),
+        top_k=get("top_k", 1, int),
+        top_p=get("top_p", 0.0, float),
+        repetition_penalty=get("repetition_penalty", 1.0, float),
+        random_seed=get("random_seed", 0, int),
+        stop_words=stop_words,
+    )
+
+
+def add_triton_routes(app: web.Application, engine, model_name: str = "ensemble",
+                      max_output: int = 512) -> None:
+    known = {model_name, "ensemble"}
+
+    async def server_ready(request: web.Request) -> web.Response:
+        return web.json_response({"ready": True})
+
+    async def model_ready(request: web.Request) -> web.Response:
+        if request.match_info["model"] not in known:
+            raise web.HTTPNotFound(
+                text=f"unknown model {request.match_info['model']!r}")
+        return web.json_response({"ready": True})
+
+    async def model_index(request: web.Request) -> web.Response:
+        # parity: GrpcTritonClient.get_model_list / load_model discovery
+        return web.json_response(
+            [{"name": n, "state": "READY"} for n in sorted(known)])
+
+    def _check_model(request: web.Request) -> None:
+        if request.match_info["model"] not in known:
+            raise web.HTTPNotFound(
+                text=f"unknown model {request.match_info['model']!r}")
+
+    @instrumented("triton_generate")
+    async def generate(request: web.Request) -> web.Response:
+        _check_model(request)
+        body = await request.json()
+        text_input = str(_first(body.get("text_input", "")))
+        if not text_input:
+            raise web.HTTPBadRequest(text="text_input is required")
+        params = _params_from_triton(body, max_output)
+        timer = obs_metrics.RequestTimer("triton_generate")
+        engine.start()
+        stream = engine.stream_text(text_input, params)
+        chunks = []
+        async for chunk in iterate_in_thread(iter(stream)):
+            timer.token(1)  # one chunk ≈ one decode step
+            chunks.append(chunk)
+        timer.finish()
+        return web.json_response({"model_name": request.match_info["model"],
+                                  "text_output": "".join(chunks)})
+
+    @instrumented("triton_generate_stream")
+    async def generate_stream(request: web.Request) -> web.StreamResponse:
+        _check_model(request)
+        body = await request.json()
+        text_input = str(_first(body.get("text_input", "")))
+        if not text_input:
+            raise web.HTTPBadRequest(text="text_input is required")
+        params = _params_from_triton(body, max_output)
+        timer = obs_metrics.RequestTimer("triton_generate")
+        engine.start()
+        stream = engine.stream_text(text_input, params)
+
+        resp = web.StreamResponse(
+            headers={"Content-Type": "text/event-stream",
+                     "Cache-Control": "no-cache"})
+        await resp.prepare(request)
+        async for chunk in iterate_in_thread(iter(stream)):
+            timer.token(1)  # one chunk ≈ one decode step
+            # decoupled-mode delta responses
+            # (reference: config.pbtxt.j2 decoupled_mode, client callback
+            # trt_llm.py:417-442 checks triton_final_response)
+            payload = {"model_name": request.match_info["model"],
+                       "text_output": chunk,
+                       "triton_final_response": False}
+            await resp.write(f"data: {json.dumps(payload)}\n\n".encode())
+        timer.finish()
+        final = {"model_name": request.match_info["model"], "text_output": "",
+                 "triton_final_response": True,
+                 "finish_reason": stream.finish_reason}
+        await resp.write(f"data: {json.dumps(final)}\n\n".encode())
+        await resp.write_eof()
+        return resp
+
+    app.router.add_get("/v2/health/ready", server_ready)
+    app.router.add_get("/v2/health/live", server_ready)
+    app.router.add_post("/v2/repository/index", model_index)
+    app.router.add_get("/v2/models/{model}/ready", model_ready)
+    app.router.add_post("/v2/models/{model}/generate", generate)
+    app.router.add_post("/v2/models/{model}/generate_stream", generate_stream)
