@@ -1,0 +1,12 @@
+"""Web frontend: chat + knowledge-base UI and the chain-server client.
+
+Parity with the reference's frontend service (reference:
+RetrievalAugmentedGeneration/frontend/ — a FastAPI app mounting Gradio
+blocks at /content/converse and /content/kb plus a Riva speech layer).
+Here the UI is first-party HTML/JS served by aiohttp at the same paths,
+talking to the same chain-server API through ``ChatClient``.
+"""
+
+from .chat_client import ChatClient
+
+__all__ = ["ChatClient"]

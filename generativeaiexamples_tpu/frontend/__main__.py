@@ -1,0 +1,6 @@
+"""``python -m generativeaiexamples_tpu.frontend`` — frontend CLI
+(reference: frontend/frontend/__main__.py)."""
+
+from .server import main
+
+main()

@@ -1,0 +1,76 @@
+"""HTTP client for the chain server.
+
+Method-for-method parity with the reference's client (reference:
+frontend/frontend/chat_client.py): ``search`` (43), streaming ``predict``
+(72 — requests.post(stream=True), yields chunks then a ``None`` sentinel),
+``upload_documents`` (101). Outgoing requests carry W3C trace context
+(reference: frontend/tracing.py:47-63).
+"""
+
+from __future__ import annotations
+
+from typing import Generator, Optional
+
+import requests
+
+from ..obs.tracing import inject_context
+from ..utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class ChatClient:
+    def __init__(self, server_url: str, model_name: str = "",
+                 timeout: float = 120.0):
+        self.server_url = server_url.rstrip("/")
+        self.model_name = model_name
+        self.timeout = timeout
+
+    def search(self, prompt: str, num_docs: int = 4) -> list[dict]:
+        """Document retrieval (reference: chat_client.py:43)."""
+        resp = requests.post(
+            f"{self.server_url}/documentSearch",
+            json={"content": prompt, "num_docs": num_docs},
+            headers=inject_context({}), timeout=self.timeout)
+        resp.raise_for_status()
+        return resp.json()
+
+    def predict(self, query: str, use_knowledge_base: bool = True,
+                num_tokens: int = 256, context: str = "",
+                ) -> Generator[Optional[str], None, None]:
+        """Stream answer chunks; yields ``None`` when the stream ends
+        (reference: chat_client.py:72-99 — 16-byte chunk reads with a
+        final None sentinel)."""
+        import codecs
+        decoder = codecs.getincrementaldecoder("utf-8")(errors="replace")
+        with requests.post(
+                f"{self.server_url}/generate",
+                json={"question": query, "context": context,
+                      "use_knowledge_base": use_knowledge_base,
+                      "num_tokens": num_tokens},
+                headers=inject_context({}), stream=True,
+                timeout=self.timeout) as resp:
+            resp.raise_for_status()
+            for chunk in resp.iter_content(chunk_size=16,
+                                           decode_unicode=False):
+                # incremental decode: multi-byte UTF-8 sequences may
+                # straddle the 16-byte chunk boundary
+                text = decoder.decode(chunk)
+                if text:
+                    yield text
+        tail = decoder.decode(b"", final=True)
+        if tail:
+            yield tail
+        yield None
+
+    def upload_documents(self, file_paths: list[str]) -> None:
+        """Upload files into the knowledge base
+        (reference: chat_client.py:101-127)."""
+        for path in file_paths:
+            with open(path, "rb") as f:
+                resp = requests.post(
+                    f"{self.server_url}/uploadDocument",
+                    files={"file": (path.split("/")[-1], f)},
+                    headers=inject_context({}), timeout=self.timeout)
+            resp.raise_for_status()
+            logger.info("uploaded %s", path)

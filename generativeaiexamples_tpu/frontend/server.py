@@ -1,0 +1,152 @@
+"""Frontend app server: static UI + thin API proxy to the chain server.
+
+Parity with the reference's frontend service (reference:
+frontend/frontend/__main__.py parse_args, api.py APIServer.configure_routes
+— pages mounted at /content/converse and /content/kb). The browser talks
+only to this server; this server talks to the chain server through
+``ChatClient`` (same topology as the reference, where Gradio callbacks call
+chat_client server-side)."""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+from typing import Optional
+
+from aiohttp import web
+
+from ..obs import metrics as obs_metrics
+from ..serving.streaming import iterate_in_thread
+from ..utils.logging import get_logger
+from .chat_client import ChatClient
+
+logger = get_logger(__name__)
+
+_STATIC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "static")
+
+
+def create_app(client: ChatClient) -> web.Application:
+    app = web.Application(client_max_size=100 * 1024 ** 2)
+    uploads: list[dict] = []  # kb page file table (reference: kb.py)
+
+    async def index(request: web.Request) -> web.Response:
+        raise web.HTTPFound("/content/converse")
+
+    async def converse(request: web.Request) -> web.FileResponse:
+        return web.FileResponse(os.path.join(_STATIC, "converse.html"))
+
+    async def kb(request: web.Request) -> web.FileResponse:
+        return web.FileResponse(os.path.join(_STATIC, "kb.html"))
+
+    async def api_generate(request: web.Request) -> web.StreamResponse:
+        body = await request.json()
+        resp = web.StreamResponse(
+            headers={"Content-Type": "text/event-stream",
+                     "Cache-Control": "no-cache"})
+        await resp.prepare(request)
+
+        def chunks():
+            for chunk in client.predict(
+                    body.get("question", ""),
+                    use_knowledge_base=bool(body.get("use_knowledge_base", True)),
+                    num_tokens=int(body.get("num_tokens", 256)),
+                    context=body.get("context", "")):
+                if chunk is None:
+                    return
+                yield chunk
+
+        try:
+            async for chunk in iterate_in_thread(chunks()):
+                await resp.write(chunk.encode("utf-8"))
+        except (ConnectionResetError, ConnectionError):
+            pass
+        except Exception as exc:  # noqa: BLE001 — surface to the UI
+            logger.exception("proxy generate failed")
+            await resp.write(f"\n[error] {exc}".encode())
+        await resp.write_eof()
+        return resp
+
+    async def api_search(request: web.Request) -> web.Response:
+        body = await request.json()
+        loop = asyncio.get_running_loop()
+        try:
+            docs = await loop.run_in_executor(
+                None, lambda: client.search(body.get("content", ""),
+                                            int(body.get("num_docs", 4))))
+        except Exception:  # noqa: BLE001 — context pane is best-effort
+            docs = []
+        return web.json_response(docs)
+
+    async def api_upload(request: web.Request) -> web.Response:
+        reader = await request.multipart()
+        field = await reader.next()
+        while field is not None and field.name != "file":
+            field = await reader.next()
+        if field is None:
+            raise web.HTTPUnprocessableEntity(text="no 'file' field")
+        filename = os.path.basename(field.filename or "upload.bin")
+        import shutil
+        import tempfile
+        # Per-upload temp dir: preserves the basename (ChatClient names the
+        # upload after it) with no collision between concurrent uploads of
+        # the same filename.
+        tmp_dir = tempfile.mkdtemp(prefix="gaie-upload-")
+        path = os.path.join(tmp_dir, filename)
+        with open(path, "wb") as f:
+            while True:
+                chunk = await field.read_chunk()
+                if not chunk:
+                    break
+                f.write(chunk)
+        entry = {"filename": filename, "status": "uploading"}
+        uploads.append(entry)
+        loop = asyncio.get_running_loop()
+        try:
+            await loop.run_in_executor(
+                None, lambda: client.upload_documents([path]))
+            entry["status"] = "ingested"
+        except Exception as exc:  # noqa: BLE001
+            entry["status"] = f"failed: {exc}"
+            raise web.HTTPInternalServerError(text=str(exc)) from exc
+        finally:
+            shutil.rmtree(tmp_dir, ignore_errors=True)
+        obs_metrics.REGISTRY.counter("frontend_uploads_total").inc()
+        return web.json_response(entry)
+
+    async def api_kb(request: web.Request) -> web.Response:
+        return web.json_response(uploads)
+
+    async def health(request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    app.router.add_get("/", index)
+    app.router.add_get("/content/converse", converse)
+    app.router.add_get("/content/kb", kb)
+    app.router.add_static("/static/", _STATIC)
+    app.router.add_post("/api/generate", api_generate)
+    app.router.add_post("/api/search", api_search)
+    app.router.add_post("/api/upload", api_upload)
+    app.router.add_get("/api/kb", api_kb)
+    app.router.add_get("/health", health)
+    return app
+
+
+def main(argv: Optional[list[str]] = None) -> None:
+    """CLI parity with the reference frontend
+    (reference: frontend/frontend/__main__.py:28-107)."""
+    parser = argparse.ArgumentParser(description="TPU RAG frontend")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8090)
+    parser.add_argument("--chain-server-url",
+                        default=os.environ.get("APP_SERVERURL",
+                                               "http://localhost:8081"))
+    args = parser.parse_args(argv)
+    client = ChatClient(args.chain_server_url)
+    logger.info("frontend on %s:%d -> chain server %s",
+                args.host, args.port, args.chain_server_url)
+    web.run_app(create_app(client), host=args.host, port=args.port)
+
+
+if __name__ == "__main__":
+    main()

@@ -88,23 +88,29 @@ def resolve_topology(world_size: int = 0, tp: int = 0, pp: int = 1,
     return world, tp, pp
 
 
-def setup_compile_cache(model_dir: Optional[str], world: int) -> str:
-    """Content-addressed XLA compilation cache.
+def setup_compile_cache(identity: str, world: int) -> str:
+    """Persistent XLA compilation cache.
 
-    The cache dir is keyed by world size + platform the way the reference
-    keys engines by world-size + compute capability
-    (reference: model.py:140-145 ``trt-w{ws}-cc{cc}``). Enabled for
-    accelerator backends only: XLA:CPU AOT results encode exact host
-    machine features, so a persistent CPU cache poisons runs on any other
-    host (set GAIE_COMPILE_CACHE=1 to force).
+    The cache dir is keyed by model identity + world size + platform the
+    way the reference keys engines by world-size + compute capability
+    (reference: model.py:140-145 ``trt-w{ws}-cc{cc}``). Compilation
+    depends on program geometry (shapes/dtypes/topology), not weight
+    bytes, so the identity is the model name + dtype + quantization mode —
+    no content hashing of multi-GB checkpoints on the startup path.
+    Enabled for accelerator backends only: XLA:CPU AOT results encode
+    exact host machine features, so a persistent CPU cache poisons runs on
+    any other host (set GAIE_COMPILE_CACHE=1 to force). Location:
+    $GAIE_CACHE_DIR or /tmp/generativeaiexamples_tpu — never inside the
+    checkpoint directory.
     """
     import jax
     platform = jax.devices()[0].platform
     if platform == "cpu" and not os.environ.get("GAIE_COMPILE_CACHE"):
         return ""
-    base = (os.environ.get("GAIE_CACHE_DIR") or model_dir
+    base = (os.environ.get("GAIE_CACHE_DIR")
             or os.path.join("/tmp", "generativeaiexamples_tpu"))
-    cache_dir = os.path.join(base, f"xla-w{world}-{platform}")
+    slug = "".join(c if c.isalnum() or c in "-._" else "-" for c in identity)
+    cache_dir = os.path.join(base, f"xla-{slug}-w{world}-{platform}")
     os.makedirs(cache_dir, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
@@ -139,7 +145,7 @@ def build_services(model_type: str = "dev", model_name: str = "",
     world, tp, pp = resolve_topology(world_size, tp, pp)
     mesh = make_mesh(MeshPlan(tp=tp, pp=pp), jax.devices()[:world]) \
         if world > 1 else None
-    setup_compile_cache(model_path or None, world)
+    setup_compile_cache(f"{model_name}-{dtype}-{quantization or 'raw'}", world)
 
     if model_type == "dev":
         # Random-init tiny model: air-gapped dev/e2e mode (the 'fake

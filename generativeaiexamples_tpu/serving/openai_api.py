@@ -98,7 +98,11 @@ def add_openai_routes(app: web.Application, engine, model_name: str,
         if not prompt:
             raise web.HTTPUnprocessableEntity(
                 text="empty prompt/messages")
-        params = _sampling_from_body(body, max_output)
+        try:
+            params = _sampling_from_body(body, max_output)
+        except (ValueError, TypeError) as exc:
+            raise web.HTTPBadRequest(
+                text=f"invalid sampling parameters: {exc}") from exc
         rid = f"cmpl-{uuid.uuid4().hex[:24]}"
         created = int(time.time())
         timer = obs_metrics.RequestTimer(f"serve_{kind}")
@@ -114,19 +118,25 @@ def add_openai_routes(app: web.Application, engine, model_name: str,
                 headers={"Content-Type": "text/event-stream",
                          "Cache-Control": "no-cache"})
             await resp.prepare(request)
-            async for chunk in iterate_in_thread(iter(stream)):
-                # each emitted chunk ≈ one decode step (one token)
-                timer.token(1)
-                payload = _completion_payload(
-                    rid, model_name, chunk, None, kind=kind,
-                    created=created, stream_delta=True)
-                await resp.write(f"data: {json.dumps(payload)}\n\n".encode())
-            final = _completion_payload(rid, model_name, "",
-                                        stream.finish_reason, kind=kind,
-                                        created=created, stream_delta=True)
-            await resp.write(f"data: {json.dumps(final)}\n\n".encode())
-            await resp.write(b"data: [DONE]\n\n")
-            timer.finish()
+            try:
+                async for chunk in iterate_in_thread(iter(stream)):
+                    # each emitted chunk ≈ one decode step (one token)
+                    timer.token(1)
+                    payload = _completion_payload(
+                        rid, model_name, chunk, None, kind=kind,
+                        created=created, stream_delta=True)
+                    await resp.write(
+                        f"data: {json.dumps(payload)}\n\n".encode())
+                final = _completion_payload(rid, model_name, "",
+                                            stream.finish_reason, kind=kind,
+                                            created=created,
+                                            stream_delta=True)
+                await resp.write(f"data: {json.dumps(final)}\n\n".encode())
+                await resp.write(b"data: [DONE]\n\n")
+            except (ConnectionResetError, ConnectionError):
+                pass  # client went away mid-stream
+            finally:
+                timer.finish()
             await resp.write_eof()
             return resp
 

@@ -3,7 +3,6 @@
 from __future__ import annotations
 
 import asyncio
-import queue as _queue
 from typing import AsyncIterator, Iterator
 
 _SENTINEL = object()
@@ -11,32 +10,36 @@ _SENTINEL = object()
 
 async def iterate_in_thread(it: Iterator[str]) -> AsyncIterator[str]:
     """Drive a blocking iterator on the default executor, yielding into the
-    event loop. Never lets the producer block on a dead consumer (client
-    disconnects propagate as cancellation; the producer thread drains out).
+    event loop with no polling: the producer thread hands each item to an
+    asyncio.Queue via ``call_soon_threadsafe``. The producer never blocks
+    on a dead consumer (the queue is unbounded; a cancelled consumer flips
+    ``done`` and the producer drains out on its next item).
     """
     loop = asyncio.get_running_loop()
-    q: "_queue.SimpleQueue" = _queue.SimpleQueue()
+    q: "asyncio.Queue" = asyncio.Queue()
     done = False
+
+    def _put(item) -> None:
+        try:
+            loop.call_soon_threadsafe(q.put_nowait, item)
+        except RuntimeError:
+            pass  # loop already closed — consumer is long gone
 
     def produce() -> None:
         try:
             for chunk in it:
                 if done:
                     break
-                q.put(chunk)
+                _put(chunk)
         except BaseException as exc:  # noqa: BLE001 — surface in consumer
-            q.put(exc)
+            _put(exc)
         finally:
-            q.put(_SENTINEL)
+            _put(_SENTINEL)
 
     producer = loop.run_in_executor(None, produce)
     try:
         while True:
-            try:
-                item = q.get_nowait()
-            except _queue.Empty:
-                await asyncio.sleep(0.002)
-                continue
+            item = await q.get()
             if item is _SENTINEL:
                 break
             if isinstance(item, BaseException):

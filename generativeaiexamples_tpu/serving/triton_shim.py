@@ -88,7 +88,11 @@ def add_triton_routes(app: web.Application, engine, model_name: str = "ensemble"
         text_input = str(_first(body.get("text_input", "")))
         if not text_input:
             raise web.HTTPBadRequest(text="text_input is required")
-        params = _params_from_triton(body, max_output)
+        try:
+            params = _params_from_triton(body, max_output)
+        except (ValueError, TypeError) as exc:
+            raise web.HTTPBadRequest(
+                text=f"invalid parameters: {exc}") from exc
         timer = obs_metrics.RequestTimer("triton_generate")
         engine.start()
         stream = engine.stream_text(text_input, params)
@@ -107,7 +111,11 @@ def add_triton_routes(app: web.Application, engine, model_name: str = "ensemble"
         text_input = str(_first(body.get("text_input", "")))
         if not text_input:
             raise web.HTTPBadRequest(text="text_input is required")
-        params = _params_from_triton(body, max_output)
+        try:
+            params = _params_from_triton(body, max_output)
+        except (ValueError, TypeError) as exc:
+            raise web.HTTPBadRequest(
+                text=f"invalid parameters: {exc}") from exc
         timer = obs_metrics.RequestTimer("triton_generate")
         engine.start()
         stream = engine.stream_text(text_input, params)
@@ -116,20 +124,24 @@ def add_triton_routes(app: web.Application, engine, model_name: str = "ensemble"
             headers={"Content-Type": "text/event-stream",
                      "Cache-Control": "no-cache"})
         await resp.prepare(request)
-        async for chunk in iterate_in_thread(iter(stream)):
-            timer.token(1)  # one chunk ≈ one decode step
-            # decoupled-mode delta responses
-            # (reference: config.pbtxt.j2 decoupled_mode, client callback
-            # trt_llm.py:417-442 checks triton_final_response)
-            payload = {"model_name": request.match_info["model"],
-                       "text_output": chunk,
-                       "triton_final_response": False}
-            await resp.write(f"data: {json.dumps(payload)}\n\n".encode())
-        timer.finish()
-        final = {"model_name": request.match_info["model"], "text_output": "",
-                 "triton_final_response": True,
-                 "finish_reason": stream.finish_reason}
-        await resp.write(f"data: {json.dumps(final)}\n\n".encode())
+        try:
+            async for chunk in iterate_in_thread(iter(stream)):
+                timer.token(1)  # one chunk ≈ one decode step
+                # decoupled-mode delta responses
+                # (reference: config.pbtxt.j2 decoupled_mode, client
+                # callback trt_llm.py:417-442 checks triton_final_response)
+                payload = {"model_name": request.match_info["model"],
+                           "text_output": chunk,
+                           "triton_final_response": False}
+                await resp.write(f"data: {json.dumps(payload)}\n\n".encode())
+            final = {"model_name": request.match_info["model"],
+                     "text_output": "", "triton_final_response": True,
+                     "finish_reason": stream.finish_reason}
+            await resp.write(f"data: {json.dumps(final)}\n\n".encode())
+        except (ConnectionResetError, ConnectionError):
+            pass  # client went away mid-stream
+        finally:
+            timer.finish()
         await resp.write_eof()
         return resp
 

@@ -1,0 +1,135 @@
+"""Frontend tests: chat client streaming against a live chain server, the
+proxy API routes, and the static pages."""
+
+import asyncio
+import threading
+
+import pytest
+import requests
+
+from aiohttp import web
+
+from generativeaiexamples_tpu.chains.server import create_app as chain_app
+from generativeaiexamples_tpu.frontend.chat_client import ChatClient
+from generativeaiexamples_tpu.frontend.server import create_app as frontend_app
+from generativeaiexamples_tpu.utils.errors import ConfigError
+
+
+def _serve(app):
+    """Run an aiohttp app on a random port in a daemon thread."""
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+    box = {}
+
+    def run():
+        asyncio.set_event_loop(loop)
+
+        async def start():
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            box["port"] = runner.addresses[0][1]
+            started.set()
+
+        loop.run_until_complete(start())
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(30)
+    return f"http://127.0.0.1:{box['port']}", loop
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    """chain server (echo LLM + hash embedder) + frontend, both live."""
+    from generativeaiexamples_tpu.chains.examples.developer_rag import QAChatbot
+    from generativeaiexamples_tpu.chains.llm import EchoLLM
+    from generativeaiexamples_tpu.embed.encoder import HashEmbedder
+    from generativeaiexamples_tpu.utils.app_config import AppConfig
+    from generativeaiexamples_tpu.utils.configuration import from_dict
+
+    cfg = from_dict(AppConfig, {
+        "llm": {"model_engine": "echo"},
+        "embeddings": {"model_engine": "hash", "dimensions": 64},
+        "text_splitter": {"chunk_size": 120, "chunk_overlap": 20},
+    })
+    example = QAChatbot(llm=EchoLLM(prefix="", tail_chars=4000),
+                        embedder=HashEmbedder(dim=64), config=cfg)
+    chain_url, chain_loop = _serve(chain_app(
+        example, upload_dir=str(tmp_path_factory.mktemp("uploads"))))
+    fe_url, fe_loop = _serve(frontend_app(ChatClient(chain_url)))
+    yield chain_url, fe_url
+    chain_loop.call_soon_threadsafe(chain_loop.stop)
+    fe_loop.call_soon_threadsafe(fe_loop.stop)
+
+
+def test_chat_client_roundtrip(stack, tmp_path):
+    chain_url, _ = stack
+    client = ChatClient(chain_url)
+    doc = tmp_path / "facts.txt"
+    doc.write_text("The ICI mesh links TPU chips at terabit speeds.")
+    client.upload_documents([str(doc)])
+
+    hits = client.search("ICI mesh", num_docs=2)
+    assert hits and hits[0]["source"] == "facts.txt"
+
+    chunks = list(client.predict("What links TPU chips?", num_tokens=4000))
+    assert chunks[-1] is None  # sentinel parity (chat_client.py:72-99)
+    text = "".join(c for c in chunks if c)
+    assert "ICI" in text
+
+
+def test_frontend_pages_and_static(stack):
+    _, fe_url = stack
+    for path, marker in [("/content/converse", "Converse"),
+                         ("/content/kb", "Knowledge Base"),
+                         ("/static/style.css", "--accent")]:
+        resp = requests.get(f"{fe_url}{path}", timeout=10)
+        assert resp.ok
+        assert marker in resp.text
+    # root redirects to converse
+    resp = requests.get(fe_url, timeout=10)
+    assert resp.url.endswith("/content/converse")
+
+
+def test_frontend_proxy_generate_and_search(stack, tmp_path):
+    _, fe_url = stack
+    doc = tmp_path / "notes.txt"
+    doc.write_text("The MXU performs 128x128 matmuls per cycle.")
+    with open(doc, "rb") as f:
+        resp = requests.post(f"{fe_url}/api/upload",
+                             files={"file": ("notes.txt", f)}, timeout=30)
+    assert resp.ok, resp.text
+    assert resp.json()["status"] == "ingested"
+
+    table = requests.get(f"{fe_url}/api/kb", timeout=10).json()
+    assert any(e["filename"] == "notes.txt" and e["status"] == "ingested"
+               for e in table)
+
+    resp = requests.post(f"{fe_url}/api/generate",
+                         json={"question": "What does the MXU do?",
+                               "use_knowledge_base": True,
+                               "num_tokens": 4000},
+                         stream=True, timeout=30)
+    body = b"".join(resp.iter_content(chunk_size=64)).decode()
+    assert "MXU" in body
+
+    docs = requests.post(f"{fe_url}/api/search",
+                         json={"content": "matmul", "num_docs": 4},
+                         timeout=10).json()
+    assert docs and "notes.txt" in {d["source"] for d in docs}
+
+
+def test_speech_gated():
+    try:
+        import riva.client  # noqa: F401
+        pytest.skip("riva installed")
+    except ImportError:
+        pass
+    from generativeaiexamples_tpu.frontend.speech import ASRClient, TTSClient
+    with pytest.raises(ConfigError, match="riva"):
+        ASRClient()
+    with pytest.raises(ConfigError, match="riva"):
+        TTSClient()
