@@ -1,0 +1,204 @@
+"""Recursive query-decomposition agent.
+
+Parity with the reference's query-decomposition example
+(reference: examples/query_decomposition_rag/chains.py): the LLM is asked
+to either request a tool — emitting JSON ``{"Tool_Request": ...,
+"Generated Sub Questions": [...]}`` — or finish with
+``Tool_Request: "Done"``. Tools: **Search** (RAG retrieval + per-question
+answer extraction, chains.py:293) and **Math** (LLM arithmetic,
+chains.py:307). A ``Ledger`` accumulates sub-question/answer pairs
+(chains.py:62); search recursion is capped at 3 rounds
+(``CustomOutputParser.parse``, chains.py:121-141); the final answer is
+synthesized from the ledger (chains.py:245-276)."""
+
+from __future__ import annotations
+
+import base64
+import json
+import re
+from dataclasses import dataclass, field
+from typing import Generator, Optional
+
+from ...embed.encoder import get_embedder
+from ...retrieval.docstore import Document, DocumentIndex
+from ...utils.app_config import get_config
+from ...utils.logging import get_logger
+from ..base import BaseExample
+from ..llm import get_llm
+from ..readers import read_document
+from ..splitter import TokenTextSplitter
+
+logger = get_logger(__name__)
+
+MAX_SEARCH_ROUNDS = 3  # reference: chains.py:131
+
+DECOMPOSE_PROMPT = """\
+You are an assistant that decomposes a complex question into simpler \
+sub-questions and picks one tool per step.
+
+Tools:
+- "Search": look up facts in the knowledge base.
+- "Math": perform an arithmetic computation.
+- "Done": you have enough information to answer.
+
+Question: {question}
+
+Findings so far:
+{ledger}
+
+Reply with ONLY a JSON object of the form
+{{"Tool_Request": "<Search|Math|Done>", "Generated Sub Questions": ["..."]}}
+JSON:"""
+
+ANSWER_EXTRACT_PROMPT = """\
+Context: {context}
+Question: {question}
+Answer the question in one short sentence using only the context. \
+If the context has no answer, say "unknown".
+Answer:"""
+
+MATH_PROMPT = """\
+Compute the result for: {question}
+Reply with only the numeric result.
+Result:"""
+
+FINAL_PROMPT = """\
+Original question: {question}
+
+Facts gathered:
+{ledger}
+
+Using only these facts, write the final answer to the original question.
+Final answer:"""
+
+
+@dataclass
+class Ledger:
+    """Accumulated sub-question/answer state (reference: chains.py:62-96)."""
+    question_trace: list[str] = field(default_factory=list)
+    answer_trace: list[str] = field(default_factory=list)
+    done: bool = False
+    search_calls: int = 0
+
+    def render(self) -> str:
+        if not self.question_trace:
+            return "(none yet)"
+        return "\n".join(f"- Q: {q}\n  A: {a}" for q, a in
+                         zip(self.question_trace, self.answer_trace))
+
+
+def parse_tool_request(text: str) -> tuple[str, list[str]]:
+    """Extract the JSON tool request from LLM output
+    (reference: CustomOutputParser.parse, chains.py:121-141 — tolerant of
+    surrounding prose)."""
+    match = re.search(r"\{.*\}", text, re.DOTALL)
+    if not match:
+        return "Done", []
+    try:
+        obj = json.loads(match.group(0))
+    except json.JSONDecodeError:
+        return "Done", []
+    tool = str(obj.get("Tool_Request", "Done")).strip()
+    subs = obj.get("Generated Sub Questions") or obj.get("sub_questions") or []
+    if isinstance(subs, str):
+        subs = [subs]
+    return tool, [str(s) for s in subs if s]
+
+
+class QueryDecompositionChatbot(BaseExample):
+    def __init__(self, llm=None, embedder=None,
+                 index: Optional[DocumentIndex] = None, config=None,
+                 engine=None):
+        self.config = config or get_config()
+        self.llm = llm or get_llm(self.config, engine=engine)
+        embedder = embedder or (index.embedder if index else None) or \
+            get_embedder(self.config.embeddings.model_engine,
+                         self.config.embeddings.model_name,
+                         dim=self.config.embeddings.dimensions)
+        self.index = index or DocumentIndex(embedder)
+        self.splitter = TokenTextSplitter(
+            chunk_size=self.config.text_splitter.chunk_size,
+            chunk_overlap=self.config.text_splitter.chunk_overlap)
+
+    # ---------------------------------------------------------- ingestion
+
+    def ingest_docs(self, data_dir: str, filename: str) -> None:
+        text = read_document(data_dir)
+        chunks = self.splitter.split_text(text)
+        encoded = base64.b64encode(filename.encode()).decode()
+        self.index.add_documents(
+            [Document(text=c, metadata={"source": filename,
+                                        "source_b64": encoded, "chunk": i})
+             for i, c in enumerate(chunks)])
+
+    # -------------------------------------------------------------- tools
+
+    def search(self, sub_question: str) -> str:
+        """RAG lookup + answer extraction (reference: chains.py:293-305)."""
+        docs = self.index.similarity_search(
+            sub_question, k=self.config.retriever.top_k)
+        context = "\n".join(d.text for d in docs)
+        return self.llm.complete(
+            ANSWER_EXTRACT_PROMPT.format(context=context,
+                                         question=sub_question),
+            max_tokens=64, stop=["\n\n"]).strip()
+
+    def math(self, sub_question: str) -> str:
+        """LLM arithmetic (reference: chains.py:307-318)."""
+        return self.llm.complete(MATH_PROMPT.format(question=sub_question),
+                                 max_tokens=32, stop=["\n"]).strip()
+
+    # -------------------------------------------------------------- agent
+
+    def run_agent(self, question: str, max_steps: int = 6) -> Ledger:
+        """Decompose-and-solve loop (reference: run_agent, chains.py:245)."""
+        ledger = Ledger()
+        for _ in range(max_steps):
+            out = self.llm.complete(
+                DECOMPOSE_PROMPT.format(question=question,
+                                        ledger=ledger.render()),
+                max_tokens=256, stop=["\n\n\n"])
+            tool, subs = parse_tool_request(out)
+            if tool.lower() == "search":
+                # recursion guard (reference: chains.py:131)
+                if ledger.search_calls >= MAX_SEARCH_ROUNDS:
+                    break
+                ledger.search_calls += 1
+                for sub in subs or [question]:
+                    answer = self.search(sub)
+                    ledger.question_trace.append(sub)
+                    ledger.answer_trace.append(answer)
+            elif tool.lower() == "math":
+                for sub in subs or [question]:
+                    answer = self.math(sub)
+                    ledger.question_trace.append(sub)
+                    ledger.answer_trace.append(answer)
+            else:  # Done (or unparseable → stop decomposing)
+                ledger.done = True
+                break
+        return ledger
+
+    # -------------------------------------------------------------- chains
+
+    def llm_chain(self, context: str, question: str, num_tokens: int,
+                  ) -> Generator[str, None, None]:
+        prompt = self.config.prompts.chat_template.format(
+            context_str=context or "", query_str=question)
+        yield from self.llm.stream(prompt, max_tokens=num_tokens,
+                                   stop=["</s>", "[INST]"])
+
+    def rag_chain(self, prompt: str, num_tokens: int,
+                  ) -> Generator[str, None, None]:
+        ledger = self.run_agent(prompt)
+        # final synthesis streamed (reference: extract_answer, chains.py:278)
+        yield from self.llm.stream(
+            FINAL_PROMPT.format(question=prompt, ledger=ledger.render()),
+            max_tokens=num_tokens, stop=["</s>"])
+
+    def document_search(self, content: str, num_docs: int) -> list[dict]:
+        docs = self.index.similarity_search(content, k=num_docs)
+        return [{"score": d.score, "source": d.metadata.get("source", ""),
+                 "content": d.text} for d in docs]
+
+
+Example = QueryDecompositionChatbot
