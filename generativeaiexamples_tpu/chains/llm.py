@@ -81,7 +81,14 @@ class EngineLLM(LLM):
                                 stop_words=list(stop or []),
                                 temperature=temperature, top_k=top_k,
                                 top_p=top_p)
-        return iter(self.engine.stream_text(prompt, params))
+        stream = self.engine.stream_text(prompt, params)
+        try:
+            yield from stream
+        finally:
+            if stream.finish_reason is None:
+                # consumer abandoned the generator mid-stream: release the
+                # decode slot instead of generating to max_tokens
+                stream.cancel()
 
 
 class OpenAICompatLLM(LLM):
@@ -112,12 +119,11 @@ class OpenAICompatLLM(LLM):
                 "max_tokens": max_tokens, "stream": True,
                 "temperature": temperature, "top_p": top_p,
                 "stop": list(stop or [])}
-        if top_k == 1 and temperature == 1.0:
-            # Both knobs at their (reference-parity greedy) defaults:
-            # express greedy via temperature=0, portable to servers that
-            # reject non-standard arguments (the real OpenAI API 400s on
-            # unknown fields). An explicit temperature wins over the
-            # top_k default.
+        if top_k == 1:
+            # top_k==1 means greedy regardless of temperature (EngineLLM /
+            # ops.sampling semantics); express it as temperature=0, portable
+            # to servers that reject non-standard arguments (the real OpenAI
+            # API 400s on unknown fields).
             body["temperature"] = 0.0
         elif top_k > 1 and self.send_top_k:
             body["top_k"] = top_k

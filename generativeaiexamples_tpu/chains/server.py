@@ -129,9 +129,9 @@ def create_app(example: BaseExample,
         try:
             async for chunk in iterate_in_thread(run_chain()):
                 await resp.write(chunk.encode("utf-8"))
+            await resp.write_eof()
         except (ConnectionResetError, ConnectionError):
             logger.info("client disconnected mid-stream")
-        await resp.write_eof()
         return resp
 
     @instrumented("document_search")

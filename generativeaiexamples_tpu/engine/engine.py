@@ -1,21 +1,31 @@
-"""Continuous-batching inference engine.
+"""Continuous-batching inference engine with a paged KV cache.
 
 The TPU-native replacement for the reference's Triton + TRT-LLM C++ serving
-core with "inflight fused batching"
+core with "inflight fused batching" and paged KV
 (reference: ensemble_models/llama/tensorrt_llm/config.pbtxt.j2:28-34,
 model_server/server.py:67-71). Architecture:
 
-- **Decode slots.** A fixed-size batch of KV-cache slots (static shapes for
+- **Decode slots.** A fixed-size batch of decode requests (static shapes for
   XLA). Every decode step runs the whole slot batch through one jitted
-  program; inactive slots are masked. This is inflight batching: requests
-  join and leave the batch between steps, the compiled program never changes.
-- **Bucketed prefill.** Prompts are padded to the nearest static bucket and
-  prefilled as a separate jitted call (one compile per bucket), then their
-  KV is scattered into a free slot — the prefill/decode disaggregation that
-  TRT-LLM's fused batching does inside C++.
-- **Host-side scheduler thread.** Python owns admission, retirement, stop
-  words, and streaming; the device owns math. The per-step host<->device
-  traffic is one (B,) token vector.
+  program; inactive slots are masked. Requests join and leave the batch
+  between rounds, the compiled program never changes.
+- **Paged KV pool.** KV lives in a shared pool of fixed-size pages; each
+  slot holds a block table mapping logical to physical pages. Admission
+  allocates a request's full extent (prompt + max_tokens) and backpressures
+  when the pool is exhausted — so cache capacity is sized to HBM, not to
+  ``slots × max_len``. Decode attention gathers only the smallest page
+  window covering the longest active sequence (bucketed per compile), so
+  HBM reads scale with live context.
+- **Multi-step decode rounds.** Each dispatch is a ``lax.scan`` of
+  ``steps_per_round`` decode steps with *device-side* eos/length
+  termination — one host<->device round trip per K tokens instead of per
+  token, which is what makes decode fast over a remote device link.
+- **Dispatch-ahead.** Up to ``dispatch_depth`` rounds are enqueued on the
+  device before the host blocks harvesting the oldest, overlapping host
+  processing and device compute.
+- **Bucketed prefill.** Prompts are padded to the nearest static bucket
+  (a page multiple) and prefilled as a separate jitted call, then their KV
+  is scattered into the slot's pages.
 - **Streaming.** Each request gets a thread-safe ``TokenStream`` — the
   decoupled-response equivalent of the reference's gRPC streaming callbacks
   (reference: model_server_client/trt_llm.py:417-442).
@@ -27,8 +37,9 @@ import itertools
 import queue
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Iterator, Optional, Sequence
+from typing import Iterator, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
@@ -39,15 +50,20 @@ from ..models import llama
 from ..models.configs import LlamaConfig
 from ..models.tokenizer import Tokenizer
 from ..ops.sampling import apply_repetition_penalty, sample, seen_mask
-from ..parallel.sharding import kv_cache_spec, llama_param_specs, shard_params
+from ..parallel.sharding import (llama_param_specs, paged_kv_cache_spec,
+                                 shard_params)
 from ..utils.errors import EngineError, SchedulerFullError
 from .detokenizer import IncrementalDetokenizer, StopChecker
 from .sampling_params import SamplingParams
 
 
+def _ceil_div(a: int, b: int) -> int:
+    return -(-a // b)
+
+
 @dataclass(frozen=True)
 class EngineConfig:
-    """Engine sizing. Defaults mirror the reference's engine limits
+    """Engine sizing. Limits mirror the reference's engine defaults
     (reference: model_server/__main__.py:81-92, config.pbtxt.j2:29)."""
     max_slots: int = 8                # concurrent decode requests
     max_input_length: int = 3000
@@ -56,6 +72,15 @@ class EngineConfig:
     dtype: str = "bfloat16"
     seed: int = 0
     max_queue: int = 256
+    # Paged KV pool. "auto" sizes the pool to the device's free HBM (so the
+    # default geometry actually runs on one chip); None = full capacity
+    # (max_slots x max cache extent); an int = pool size in tokens.
+    page_size: int = 128
+    kv_pool_tokens: Union[int, str, None] = "auto"
+    # Decode pipelining: tokens generated per device dispatch, and how many
+    # dispatches ride the device queue before the host blocks on results.
+    steps_per_round: int = 8
+    dispatch_depth: int = 2
 
     @property
     def max_cache_len(self) -> int:
@@ -73,6 +98,7 @@ class TokenStream:
         self.submit_time = time.monotonic()
         self.first_token_time: Optional[float] = None
         self.finish_time: Optional[float] = None
+        self.cancelled = False
 
     def _put_chunk(self, text: str) -> None:
         if text:
@@ -86,6 +112,11 @@ class TokenStream:
     def _fail(self, exc: BaseException) -> None:
         self.finish_reason = "error"
         self._q.put(("error", exc))
+
+    def cancel(self) -> None:
+        """Abort generation (e.g. the HTTP client disconnected). The
+        scheduler retires the request at the next harvested token."""
+        self.cancelled = True
 
     def __iter__(self) -> Iterator[str]:
         while True:
@@ -115,7 +146,16 @@ class _Request:
     params: SamplingParams
     detok: IncrementalDetokenizer
     stop: StopChecker
+    eff_max: int = 0          # max_tokens clamped to the cache extent
+    extent: int = 0           # prompt + eff_max (cache positions reserved)
+    slot: int = -1
+    pages: list[int] = field(default_factory=list)
+    proj_pos: int = 0         # host upper bound on the device-side pos
     generated: int = 0
+
+    @property
+    def done(self) -> bool:
+        return self.stream.finish_reason is not None
 
 
 class Engine:
@@ -129,29 +169,43 @@ class Engine:
         self.tokenizer = tokenizer
         self.mesh = mesh
         self._dtype = jnp.dtype(cfg.dtype)
-        B, T = cfg.max_slots, cfg.max_cache_len
+        B, page = cfg.max_slots, cfg.page_size
+        self._pmax = _ceil_div(cfg.max_cache_len, page)
 
         if mesh is not None:
             params = shard_params(params, mesh, llama_param_specs(model_cfg, mesh))
         self.params = params
 
-        cache = llama.init_kv_cache(model_cfg, B, T, self._dtype)
-        if mesh is not None:
-            cache = jax.tree.map(
-                lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-                cache, kv_cache_spec(model_cfg, mesh))
-# Distinct arrays per field: donated jit args must not alias.
+        # Page pool: physical page 0 is the trash page (never allocated);
+        # the allocator hands out 1..n_pages-1.
+        self._n_pages = 1 + self._resolve_pool_pages()
+        self._free_pages = list(range(1, self._n_pages))
+
+        cache = llama.init_paged_kv_cache(model_cfg, self._n_pages, page,
+                                          self._dtype)
+        # Distinct arrays per field: donated jit args must not alias.
         self._state = {
             "cache": cache,
+            "table": jnp.zeros((B, self._pmax), jnp.int32),
             "pos": jnp.zeros((B,), jnp.int32),
             "last_token": jnp.zeros((B,), jnp.int32),
             "active": jnp.zeros((B,), bool),
+            "remaining": jnp.zeros((B,), jnp.int32),
+            "eos_ok": jnp.zeros((B,), bool),
             "temp": jnp.zeros((B,), jnp.float32),
             "top_k": jnp.zeros((B,), jnp.int32),
             "top_p": jnp.zeros((B,), jnp.float32),
             "rep_pen": jnp.ones((B,), jnp.float32),
             "seen": jnp.zeros((B, model_cfg.vocab_size), bool),
         }
+        if mesh is not None:
+            cache_specs = paged_kv_cache_spec(model_cfg, mesh)
+            self._state = {
+                k: (jax.tree.map(
+                        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+                        v, cache_specs) if k == "cache"
+                    else jax.device_put(v, NamedSharding(mesh, P())))
+                for k, v in self._state.items()}
         self._base_key = jax.random.key(cfg.seed)
         self._step_counter = itertools.count()
         self._req_counter = itertools.count()
@@ -160,26 +214,72 @@ class Engine:
         self._free_slots = list(range(B))
         self._pending: "queue.Queue[tuple[_Request, SamplingParams]]" = (
             queue.Queue(maxsize=cfg.max_queue))
+        self._head: Optional[tuple[_Request, SamplingParams]] = None
+        self._pending_first: list[tuple[_Request, jax.Array]] = []
+        self._inflight: deque[tuple[dict[int, _Request], jax.Array]] = deque()
         self._wake = threading.Event()
         self._stopped = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._fatal: Optional[BaseException] = None
-        self._admitting: Optional[_Request] = None  # req in prefill flight
 
-        self.stats = {"requests": 0, "tokens_generated": 0, "decode_steps": 0,
-                      "prefills": 0}
-        # Effective prefill buckets, clipped to the prompt limit so a
-        # bucket can never exceed the cache extent.
+        self._stats_lock = threading.Lock()
+        self._stats = {"requests": 0, "tokens_generated": 0,
+                       "decode_steps": 0, "prefills": 0}
+        # Effective prefill buckets: page multiples, clipped to the prompt
+        # limit, so bucket KV scatters cleanly into whole pages.
+        page_up = lambda n: _ceil_div(n, page) * page  # noqa: E731
         self._buckets = tuple(sorted(
-            {min(b, cfg.max_input_length) for b in cfg.prefill_buckets}
-            | {cfg.max_input_length}))
+            {page_up(min(b, cfg.max_input_length)) for b in cfg.prefill_buckets}
+            | {page_up(cfg.max_input_length)}))
+        # Decode-attention page windows: power-of-two ladder up to the max.
+        ladder = []
+        w = 1
+        while w < self._pmax:
+            ladder.append(w)
+            w *= 2
+        self._windows = tuple(ladder + [self._pmax])
 
         self._build_jitted()
+
+    # -------------------------------------------------------------- sizing
+
+    def _resolve_pool_pages(self) -> int:
+        cfg, mcfg = self.cfg, self.model_cfg
+        full = cfg.max_slots * self._pmax
+        spec = cfg.kv_pool_tokens
+        if spec is None:
+            return full
+        if isinstance(spec, int):
+            return min(full, max(self._pmax, _ceil_div(spec, cfg.page_size)))
+        # "auto": fit the pool to free device memory (the reference sizes
+        # its paged pool via kv_cache_free_gpu_mem_fraction; same idea).
+        try:
+            stats = jax.local_devices()[0].memory_stats()
+            budget = int((stats["bytes_limit"] - stats["bytes_in_use"]) * 0.8)
+            per_token = (mcfg.num_layers * mcfg.num_kv_heads * mcfg.head_dim
+                         * 2 * self._dtype.itemsize)
+            pages = budget // (cfg.page_size * per_token)
+            return min(full, max(self._pmax, pages))
+        except Exception:
+            return full
+
+    @property
+    def stats(self) -> dict[str, int]:
+        with self._stats_lock:
+            return dict(self._stats)
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        with self._stats_lock:
+            self._stats[key] += n
 
     # ------------------------------------------------------------------ jit
 
     def _build_jitted(self) -> None:
         cfg, mcfg = self.cfg, self.model_cfg
+        page = cfg.page_size
+        eos = int(self.tokenizer.eos_id)
+        B = cfg.max_slots
+        L = mcfg.num_layers
 
         def prefill(params, tokens, length, temp, top_k, top_p, rep_pen, key):
             """tokens: (1, S_bucket); returns (k,v) for the bucket, the
@@ -201,21 +301,32 @@ class Engine:
             return cache["k"], cache["v"], first_tok, seen
 
         def insert(state, k_new, v_new, slot, length, first_tok,
-                   temp, top_k, top_p, rep_pen, seen):
+                   temp, top_k, top_p, rep_pen, seen, row, remaining, eos_ok):
+            """Scatter a prefilled bucket into the slot's pages and arm the
+            slot. ``row``: (Pmax,) physical page per logical page, padded
+            with 0 (trash) — bucket overhang beyond the allocated extent
+            lands in the trash page."""
+            S = k_new.shape[2]
+            nb = S // page
+            dest = row[:nb]
             cache = state["cache"]
-            zeros5 = (0, slot, 0, 0, 0)
+            kp = k_new.reshape(L, nb, page, mcfg.num_kv_heads, mcfg.head_dim)
+            vp = v_new.reshape(L, nb, page, mcfg.num_kv_heads, mcfg.head_dim)
             cache = {
-                "k": jax.lax.dynamic_update_slice(
-                    cache["k"], k_new.astype(cache["k"].dtype),
-                    (0, slot, 0, 0, 0)),
-                "v": jax.lax.dynamic_update_slice(
-                    cache["v"], v_new.astype(cache["v"].dtype), zeros5),
+                "k": cache["k"].at[:, dest].set(kp.astype(cache["k"].dtype)),
+                "v": cache["v"].at[:, dest].set(vp.astype(cache["v"].dtype)),
             }
+            # Device-side finish state: a slot whose first token already
+            # ends it (eos, or max_tokens == 1) never activates.
+            active = (remaining > 0) & ~((first_tok == eos) & eos_ok)
             return {
                 "cache": cache,
+                "table": state["table"].at[slot].set(row),
                 "pos": state["pos"].at[slot].set(length),
                 "last_token": state["last_token"].at[slot].set(first_tok),
-                "active": state["active"].at[slot].set(True),
+                "active": state["active"].at[slot].set(active),
+                "remaining": state["remaining"].at[slot].set(remaining),
+                "eos_ok": state["eos_ok"].at[slot].set(eos_ok),
                 "temp": state["temp"].at[slot].set(temp),
                 "top_k": state["top_k"].at[slot].set(top_k),
                 "top_p": state["top_p"].at[slot].set(top_p),
@@ -223,34 +334,60 @@ class Engine:
                 "seen": state["seen"].at[slot].set(seen),
             }
 
-        def decode_step(params, state, key):
-            pos = state["pos"]
-            active = state["active"]
-            tokens = state["last_token"][:, None]
-            positions = pos[:, None]
-            logits, cache = llama.apply(params, mcfg, tokens, positions,
-                                        state["cache"], kv_valid_len=pos + 1)
-            penalized = apply_repetition_penalty(
-                logits[:, 0], state["seen"], state["rep_pen"])
-            next_tok = sample(penalized, key, state["temp"],
-                              state["top_k"], state["top_p"])
-            next_tok = jnp.where(active, next_tok, 0)
-            new_state = dict(state)
-            new_state["cache"] = cache
-            new_state["pos"] = jnp.where(active, pos + 1, pos)
-            new_state["last_token"] = next_tok
-            new_state["seen"] = state["seen"].at[
-                jnp.arange(state["seen"].shape[0]), next_tok
-            ].max(active)
-            return new_state, next_tok
+        def make_round(window: int, steps: int):
+            def decode_round(params, state, key):
+                """K decode steps fused in one dispatch; returns (K, B)
+                tokens with -1 for slots inactive at step entry. eos and
+                length termination happen on-device (``active`` drops), so
+                the host only needs one transfer per round."""
+                def body(st, key_k):
+                    pos, active = st["pos"], st["active"]
+                    page_of = jnp.take_along_axis(
+                        st["table"], (pos // page)[:, None], axis=1)[:, 0]
+                    wp = jnp.where(active, page_of, 0)  # inactive -> trash
+                    logits, cache = llama.apply_decode_paged(
+                        params, mcfg, st["last_token"][:, None],
+                        pos[:, None], st["cache"], st["table"][:, :window],
+                        pos + 1, wp, pos % page)
+                    penalized = apply_repetition_penalty(
+                        logits[:, 0], st["seen"], st["rep_pen"])
+                    tok = sample(penalized, key_k, st["temp"], st["top_k"],
+                                 st["top_p"])
+                    emitted = jnp.where(active, tok, -1)
+                    remaining = jnp.where(active, st["remaining"] - 1,
+                                          st["remaining"])
+                    finished = active & (((tok == eos) & st["eos_ok"])
+                                         | (remaining <= 0))
+                    new_st = dict(
+                        st, cache=cache,
+                        pos=jnp.where(active, pos + 1, pos),
+                        last_token=jnp.where(active, tok, st["last_token"]),
+                        active=active & ~finished,
+                        remaining=remaining,
+                        seen=st["seen"].at[jnp.arange(B), tok].max(active))
+                    return new_st, emitted
+
+                state, toks = jax.lax.scan(body, state,
+                                           jax.random.split(key, steps))
+                return state, toks
+            return decode_round
 
         def release(state, slot):
             return dict(state, active=state["active"].at[slot].set(False))
 
         self._prefill = jax.jit(prefill)
         self._insert = jax.jit(insert, donate_argnums=(0,))
-        self._decode_step = jax.jit(decode_step, donate_argnums=(1,))
         self._release = jax.jit(release, donate_argnums=(0,))
+        self._make_round = make_round
+        self._round_fns: dict[int, object] = {}
+
+    def _round_fn(self, window: int):
+        fn = self._round_fns.get(window)
+        if fn is None:
+            fn = jax.jit(self._make_round(window, self.cfg.steps_per_round),
+                         donate_argnums=(1,))
+            self._round_fns[window] = fn
+        return fn
 
     # ------------------------------------------------------------- lifecycle
 
@@ -273,6 +410,43 @@ class Engine:
                 raise EngineError(
                     "engine loop did not stop within 30s; not restartable")
             self._thread = None
+        self._drain_on_stop()
+
+    def _live_requests(self) -> list[_Request]:
+        """Every request the scheduler still knows about, across all of its
+        staging structures (pending queue, head buffer, prefill-in-flight,
+        slots, in-flight rounds). The single source of truth for both the
+        fatal-error fan-out and the stop() drain — a request missed here
+        would leave its consumer blocked forever."""
+        live: list[_Request] = [r for r, _ in self._pending_first]
+        live += self._slots.values()
+        for members, _ in self._inflight:
+            live += members.values()
+        if self._head is not None:
+            live.append(self._head[0])
+            self._head = None
+        while not self._pending.empty():
+            try:
+                live.append(self._pending.get_nowait()[0])
+            except queue.Empty:
+                break
+        return live
+
+    def _drain_on_stop(self) -> None:
+        """Retire everything still live so (a) consumers blocked on streams
+        never hang forever and (b) no device slot stays active holding pages
+        that a post-restart insert would reuse."""
+        leftovers = self._live_requests()
+        self._pending_first.clear()
+        self._inflight.clear()
+        for slot in list(self._slots):
+            # device-side deactivate: safe here, the loop thread is joined
+            self._state = self._release(self._state, jnp.int32(slot))
+        for req in leftovers:
+            if req.slot in self._slots:
+                self._retire(req, "cancelled")
+            elif not req.done:
+                req.stream._finish("cancelled")
 
     def __enter__(self) -> "Engine":
         self.start()
@@ -295,9 +469,17 @@ class Engine:
                 f"{self.cfg.max_input_length}")
         if len(prompt_ids) == 0:
             raise EngineError("empty prompt")
+        eff_max = min(params.max_tokens,
+                      self.cfg.max_cache_len - len(prompt_ids))
+        need = _ceil_div(len(prompt_ids) + eff_max, self.cfg.page_size)
+        if need > self._n_pages - 1:
+            raise EngineError(
+                f"request needs {need} KV pages but the pool only has "
+                f"{self._n_pages - 1} (kv_pool_tokens too small)")
         stream = TokenStream(next(self._req_counter))
         req = _Request(stream=stream, prompt_ids=list(prompt_ids),
-                       params=params,
+                       params=params, eff_max=eff_max,
+                       extent=len(prompt_ids) + eff_max,
                        detok=IncrementalDetokenizer(self.tokenizer),
                        stop=StopChecker(params.stop_words))
         try:
@@ -309,7 +491,7 @@ class Engine:
             # The loop may have died between the check above and the put;
             # fail the stream here so callers never block forever.
             stream._fail(self._fatal)
-        self.stats["requests"] += 1
+        self._bump("requests")
         self._wake.set()
         return stream
 
@@ -331,39 +513,70 @@ class Engine:
         for b in self._buckets:
             if n <= b:
                 return b
-        return self.cfg.max_input_length
+        return self._buckets[-1]
+
+    def _window_for(self, pages: int) -> int:
+        for w in self._windows:
+            if pages <= w:
+                return w
+        return self._pmax
 
     def _run(self) -> None:
         try:
             while not self._stopped.is_set():
                 did_work = self._admit()
-                if self._slots:
-                    self._step()
+                while (self._slots
+                       and len(self._inflight) < self.cfg.dispatch_depth):
+                    self._dispatch_round()
+                    did_work = True
+                if self._pending_first:
+                    self._harvest_first()
+                    did_work = True
+                if self._inflight:
+                    self._harvest_round()
                     did_work = True
                 if not did_work:
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()
         except BaseException as exc:  # noqa: BLE001 - report to all streams
             self._fatal = exc
-            if self._admitting is not None:  # crashed mid-prefill
-                self._admitting.stream._fail(exc)
-            for req in list(self._slots.values()):
-                req.stream._fail(exc)
-            while not self._pending.empty():
-                try:
-                    self._pending.get_nowait()[0].stream._fail(exc)
-                except queue.Empty:
-                    break
+            for req in self._live_requests():
+                if not req.done:
+                    req.stream._fail(exc)
 
-    def _admit(self, max_prefills: int = 4) -> bool:
-        admitted = False
-        while self._free_slots and max_prefills > 0:
+    def _next_pending(self) -> Optional[tuple[_Request, SamplingParams]]:
+        if self._head is None:
             try:
-                req, sp = self._pending.get_nowait()
+                self._head = self._pending.get_nowait()
             except queue.Empty:
+                return None
+        return self._head
+
+    def _admit(self) -> bool:
+        """Dispatch prefill+insert for as many pending requests as slots
+        and KV pages allow. First-token harvest is deferred so it overlaps
+        with the decode rounds dispatched right after."""
+        admitted = False
+        while self._free_slots:
+            nxt = self._next_pending()
+            if nxt is None:
                 break
-            self._admitting = req
+            req, sp = nxt
+            if req.stream.cancelled:
+                self._head = None
+                req.stream._finish("cancelled")
+                continue
+            n_alloc = _ceil_div(req.extent, self.cfg.page_size)
+            if n_alloc > len(self._free_pages):
+                break  # pool backpressure: wait for pages to free up
+            self._head = None
             slot = self._free_slots.pop()
+            req.slot = slot
+            req.pages = [self._free_pages.pop() for _ in range(n_alloc)]
+            req.proj_pos = len(req.prompt_ids)
+            row = np.zeros((self._pmax,), np.int32)
+            row[:n_alloc] = req.pages
+
             bucket = self._bucket_for(len(req.prompt_ids))
             ids = req.prompt_ids + [0] * (bucket - len(req.prompt_ids))
             tokens = jnp.asarray(np.asarray(ids, np.int32)[None, :])
@@ -378,40 +591,65 @@ class Engine:
                 self._state, k_new, v_new, jnp.int32(slot), length, first_tok,
                 jnp.float32(sp.temperature), jnp.int32(sp.top_k),
                 jnp.float32(sp.top_p), jnp.float32(sp.repetition_penalty),
-                seen)
-            self.stats["prefills"] += 1
+                seen, jnp.asarray(row), jnp.int32(req.eff_max - 1),
+                jnp.bool_(not sp.ignore_eos))
+            self._bump("prefills")
             self._slots[slot] = req
-            self._admitting = None
-            self._emit(slot, req, int(first_tok))
+            self._pending_first.append((req, first_tok))
             admitted = True
-            max_prefills -= 1
         return admitted
 
-    def _step(self) -> None:
+    def _dispatch_round(self) -> None:
+        K = self.cfg.steps_per_round
+        need = max(min(r.proj_pos + K, r.extent) + 1
+                   for r in self._slots.values())
+        window = self._window_for(_ceil_div(need, self.cfg.page_size))
+        members = dict(self._slots)
         key = jax.random.fold_in(self._base_key, next(self._step_counter))
-        self._state, next_tok = self._decode_step(self.params, self._state, key)
-        self.stats["decode_steps"] += 1
-        toks = np.asarray(next_tok)
-        for slot, req in list(self._slots.items()):
-            self._emit(slot, req, int(toks[slot]))
+        self._state, toks = self._round_fn(window)(self.params, self._state,
+                                                   key)
+        for req in members.values():
+            req.proj_pos = min(req.proj_pos + K, req.extent)
+        self._inflight.append((members, toks))
+        self._bump("decode_steps", K)
 
-    def _emit(self, slot: int, req: _Request, token: int) -> None:
-        """Deliver one generated token; retire the request if finished."""
+    def _harvest_first(self) -> None:
+        pending, self._pending_first = self._pending_first, []
+        for req, first_tok in pending:
+            self._emit_token(req, int(np.asarray(first_tok)))
+
+    def _harvest_round(self) -> None:
+        members, toks_dev = self._inflight.popleft()
+        toks = np.asarray(toks_dev)  # (K, B) — blocks; overlapped by depth
+        for k in range(toks.shape[0]):
+            row = toks[k]
+            for slot, req in members.items():
+                if req.done:
+                    continue
+                tok = int(row[slot])
+                if tok < 0:
+                    continue  # slot was inactive on-device at this step
+                self._emit_token(req, tok)
+
+    def _emit_token(self, req: _Request, token: int) -> None:
+        """Deliver one generated token; retire the request if finished.
+        Finish logic mirrors the device-side termination exactly, so the
+        host and device agree on each slot's last token."""
         req.generated += 1
         req.stream.token_ids.append(token)
-        self.stats["tokens_generated"] += 1
+        self._bump("tokens_generated")
         if req.stream.first_token_time is None:
             req.stream.first_token_time = time.monotonic()
 
         finish: Optional[str] = None
         if token == self.tokenizer.eos_id and not req.params.ignore_eos:
             finish = "eos"
-        elif req.generated >= req.params.max_tokens:
-            finish = "length"
-        elif len(req.prompt_ids) + req.generated >= self.cfg.max_cache_len:
+        elif req.generated >= req.eff_max:
             finish = "length"
 
-        if finish != "eos":  # eos token itself is not emitted as text
+        if req.stream.cancelled and finish is None:
+            finish = "cancelled"
+        elif finish != "eos":  # eos token itself is not emitted as text
             chunk = req.stop.feed(req.detok.push(token))
             req.stream._put_chunk(chunk)
             if req.stop.stopped:
@@ -426,7 +664,15 @@ class Engine:
                 req.stream._put_chunk(req.stop.flush())
                 if req.stop.stopped and finish == "length":
                     finish = "stop"  # stop word surfaced in the final flush
-            del self._slots[slot]
-            self._free_slots.append(slot)
-            self._state = self._release(self._state, jnp.int32(slot))
-            req.stream._finish(finish)
+            else:
+                # Host-detected finish (stop word / cancel): the device
+                # still thinks the slot is live — deactivate it.
+                self._state = self._release(self._state, jnp.int32(req.slot))
+            self._retire(req, finish)
+
+    def _retire(self, req: _Request, finish: str) -> None:
+        del self._slots[req.slot]
+        self._free_slots.append(req.slot)
+        self._free_pages.extend(req.pages)
+        req.pages = []
+        req.stream._finish(finish)

@@ -102,13 +102,66 @@ def _iter_safetensors(path: str) -> Iterator[tuple[str, np.ndarray]]:
 def _iter_torch_bin(path: str) -> Iterator[tuple[str, np.ndarray]]:
     import torch
     for fname in sorted(os.listdir(path)):
-        if not re.match(r"pytorch_model.*\.bin$", fname) and \
-           not fname.endswith((".pth", ".pt")):
+        if not re.match(r"pytorch_model.*\.bin$", fname):
             continue
         sd = torch.load(os.path.join(path, fname), map_location="cpu",
                         weights_only=True)
         for key, t in sd.items():
             yield key, t.to(torch.float32).numpy()
+
+
+# Fairscale TP shard axis per Meta tensor (None = replicated). Matches the
+# concat dims HF's convert_llama_weights_to_hf uses when merging
+# consolidated.*.pth shards: column-parallel weights shard dim 0,
+# row-parallel dim 1, ParallelEmbedding shards the embedding dim.
+_META_SHARD_DIM = {
+    "tok_embeddings.weight": 1,
+    "output.weight": 0,
+    "norm.weight": None,
+    "attention_norm.weight": None,
+    "ffn_norm.weight": None,
+    "attention.wq.weight": 0,
+    "attention.wk.weight": 0,
+    "attention.wv.weight": 0,
+    "attention.wo.weight": 1,
+    "feed_forward.w1.weight": 0,
+    "feed_forward.w2.weight": 1,
+    "feed_forward.w3.weight": 0,
+}
+
+
+def _meta_shard_dim(key: str) -> int | None:
+    suffix = re.sub(r"^layers\.\d+\.", "", key)
+    if suffix not in _META_SHARD_DIM:
+        raise UnsupportedFormatError(
+            f"unknown Meta checkpoint tensor {key!r}: cannot determine its "
+            f"fairscale shard axis")
+    return _META_SHARD_DIM[suffix]
+
+
+def _iter_meta_pth(path: str) -> Iterator[tuple[str, np.ndarray]]:
+    """Meta/fairscale checkpoints: merge consolidated.*.pth TP shards.
+
+    Every shard holds the SAME tensor names, split along per-tensor TP axes
+    (reference: conversion_scripts/llama/weight.py:387 ``load_from_meta_llama``
+    re-shards them per rank; HF's convert script concatenates the same way).
+    A single-file checkpoint passes through unchanged."""
+    import torch
+    files = sorted(f for f in os.listdir(path) if f.endswith((".pth", ".pt")))
+    shards = [torch.load(os.path.join(path, f), map_location="cpu",
+                         weights_only=True) for f in files]
+    for key in shards[0]:
+        if key == "rope.freqs":  # precomputed buffer, not a weight
+            continue
+        parts = [s[key] for s in shards]
+        if len(parts) == 1:
+            yield key, parts[0].to(torch.float32).numpy()
+            continue
+        dim = _meta_shard_dim(key)
+        if dim is None:
+            yield key, parts[0].to(torch.float32).numpy()
+        else:
+            yield key, torch.cat(parts, dim=dim).to(torch.float32).numpy()
 
 
 def _to_numpy(t: Any) -> np.ndarray:
@@ -215,7 +268,7 @@ def load_checkpoint(path: str, cfg: LlamaConfig,
     iters: dict[str, Callable[[str], Iterator[tuple[str, np.ndarray]]]] = {
         "safetensors": _iter_safetensors,
         "pytorch_bin": _iter_torch_bin,
-        "meta_pth": _iter_torch_bin,
+        "meta_pth": _iter_meta_pth,
     }
     return params_from_named_tensors(iters[fmt](path), cfg, dtype)
 

@@ -97,6 +97,72 @@ def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int,
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
+def init_paged_kv_cache(cfg: LlamaConfig, n_pages: int, page_size: int,
+                        dtype: jnp.dtype = jnp.bfloat16) -> KVCache:
+    """Block-pool KV cache: {"k","v"}: (L, n_pages, page, KV, hd).
+
+    The pool is shared by all decode slots through per-slot block tables —
+    the XLA-static equivalent of TRT-LLM's paged KV cache
+    (reference: ensemble_models/llama/tensorrt_llm/config.pbtxt.j2:28-34).
+    Page 0 is reserved as a trash page: writes for inactive slots and
+    prefill-bucket overhang are routed there.
+    """
+    shape = (cfg.num_layers, n_pages, page_size, cfg.num_kv_heads,
+             cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def apply_decode_paged(params: Params, cfg: LlamaConfig, tokens: jax.Array,
+                       positions: jax.Array, kv_cache: KVCache,
+                       block_table: jax.Array, kv_valid_len: jax.Array,
+                       write_page: jax.Array, write_offset: jax.Array,
+                       ) -> tuple[jax.Array, KVCache]:
+    """Single-token decode step over the paged KV pool.
+
+    tokens/positions: (B, 1). block_table: (B, P) — physical page id of each
+    slot's logical page, sliced by the engine to the smallest window covering
+    every active sequence (so HBM reads scale with actual context, not cache
+    capacity). write_page/write_offset: (B,) physical destination of this
+    step's K/V (page 0 = trash for inactive slots). Returns
+    (logits (B, 1, V), updated cache).
+    """
+    B, S = tokens.shape
+    P = block_table.shape[1]
+    page = kv_cache["k"].shape[2]
+    inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta,
+                                cfg.rope_scaling_factor)
+    h = jnp.take(params["embed"], tokens, axis=0)
+
+    def layer(h: jax.Array, xs):
+        lp, kc, vc = xs  # kc/vc: (N, page, KV, hd)
+        x = rmsnorm(h, lp["attn_norm"], cfg.rms_norm_eps)
+        q = qmm(x, lp["wq"]).reshape(B, S, cfg.num_heads, cfg.head_dim)
+        k = qmm(x, lp["wk"]).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
+        v = qmm(x, lp["wv"]).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
+        q, k = apply_rope(q, k, positions, inv_freq)
+        kc = kc.at[write_page, write_offset].set(k[:, 0].astype(kc.dtype))
+        vc = vc.at[write_page, write_offset].set(v[:, 0].astype(vc.dtype))
+        kg = kc[block_table].reshape(B, P * page, cfg.num_kv_heads,
+                                     cfg.head_dim)
+        vg = vc[block_table].reshape(B, P * page, cfg.num_kv_heads,
+                                     cfg.head_dim)
+        attn = gqa_attention(q, kg, vg, positions, kv_valid_len)
+        h2 = h + qmm(attn.reshape(B, S, cfg.q_dim), lp["wo"])
+        x2 = rmsnorm(h2, lp["mlp_norm"], cfg.rms_norm_eps)
+        mlp = _moe_mlp(x2, lp, cfg) if cfg.num_experts else _dense_mlp(x2, lp)
+        return h2 + mlp, (kc, vc)
+
+    h, (new_k, new_v) = jax.lax.scan(
+        layer, h, (params["layers"], kv_cache["k"], kv_cache["v"]))
+    h = rmsnorm(h, params["final_norm"], cfg.rms_norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        logits = h.astype(jnp.float32) @ params["embed"].T.astype(jnp.float32)
+    else:
+        logits = qmm(h.astype(jnp.float32), head)
+    return logits, {"k": new_k, "v": new_v}
+
+
 def _dense_mlp(x: jax.Array, lp: dict[str, jax.Array]) -> jax.Array:
     gate = jax.nn.silu(qmm(x, lp["w_gate"]))
     return qmm(gate * qmm(x, lp["w_up"]), lp["w_down"])

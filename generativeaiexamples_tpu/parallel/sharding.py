@@ -90,6 +90,19 @@ def kv_cache_spec(cfg: LlamaConfig, mesh: Mesh) -> Specs:
     return {"k": spec, "v": spec}
 
 
+def paged_kv_cache_spec(cfg: LlamaConfig, mesh: Mesh) -> Specs:
+    """Paged cache (L, N, page, KV, hd): KV heads over tp, pages replicated.
+
+    The page pool has no batch axis (slots share it through block tables),
+    so dp does not appear; layers shard over pp like the params.
+    """
+    tp = _axis_on(mesh, "tp")
+    pp = _axis_on(mesh, "pp")
+    kv_tp = tp if tp and cfg.num_kv_heads % mesh.shape["tp"] == 0 else None
+    spec = P(pp, None, None, kv_tp, None)
+    return {"k": spec, "v": spec}
+
+
 def activation_spec(mesh: Mesh) -> P:
     """Token/hidden activations: batch over dp, replicated over tp."""
     return P(_axis_on(mesh, "dp"), None)

@@ -11,6 +11,7 @@ both classes import lazily and raise a clear error; the interface matches
 
 from __future__ import annotations
 
+import re
 from typing import Sequence
 
 import numpy as np
@@ -98,6 +99,10 @@ class PgvectorStore(VectorStore):
                 "image). Use get_vector_store('exact'|'ivfflat') or install "
                 "psycopg2.") from exc
         import psycopg2
+        if not re.match(r"^[A-Za-z_][A-Za-z0-9_]*$", table):
+            # the table name is interpolated into SQL below — reject
+            # anything that isn't a plain identifier (injection guard)
+            raise ConfigError(f"invalid pgvector table name {table!r}")
         self._dim = dim
         self.metric = metric
         self._table = table

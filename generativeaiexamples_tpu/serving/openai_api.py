@@ -10,6 +10,7 @@ nemo_infer.py:141-156), streams send true deltas.
 
 from __future__ import annotations
 
+import asyncio
 import json
 import time
 import uuid
@@ -108,8 +109,12 @@ def add_openai_routes(app: web.Application, engine, model_name: str,
         timer = obs_metrics.RequestTimer(f"serve_{kind}")
 
         engine.start()
+        loop = asyncio.get_running_loop()
         try:
-            stream = engine.stream_text(prompt, params)
+            # Tokenization off the event loop: a long prompt must not stall
+            # other in-flight requests on this single-threaded server.
+            stream = await loop.run_in_executor(
+                None, engine.stream_text, prompt, params)
         except Exception as exc:  # noqa: BLE001
             raise web.HTTPServiceUnavailable(text=str(exc)) from exc
 
@@ -119,7 +124,7 @@ def add_openai_routes(app: web.Application, engine, model_name: str,
                          "Cache-Control": "no-cache"})
             await resp.prepare(request)
             try:
-                async for chunk in iterate_in_thread(iter(stream)):
+                async for chunk in iterate_in_thread(iter(stream), on_cancel=stream.cancel):
                     # each emitted chunk ≈ one decode step (one token)
                     timer.token(1)
                     payload = _completion_payload(
@@ -133,17 +138,18 @@ def add_openai_routes(app: web.Application, engine, model_name: str,
                                             stream_delta=True)
                 await resp.write(f"data: {json.dumps(final)}\n\n".encode())
                 await resp.write(b"data: [DONE]\n\n")
+                await resp.write_eof()
             except (ConnectionResetError, ConnectionError):
                 pass  # client went away mid-stream
             finally:
                 timer.finish()
-            await resp.write_eof()
             return resp
 
-        text = "".join([c async for c in iterate_in_thread(iter(stream))])
+        text = "".join([c async for c in iterate_in_thread(iter(stream), on_cancel=stream.cancel)])
         timer.token(len(stream.token_ids))
         timer.finish()
-        n_prompt = len(engine.tokenizer.encode(prompt))
+        n_prompt = len(await loop.run_in_executor(
+            None, engine.tokenizer.encode, prompt))
         usage = {"prompt_tokens": n_prompt,
                  "completion_tokens": len(stream.token_ids),
                  "total_tokens": n_prompt + len(stream.token_ids)}
@@ -170,7 +176,6 @@ def add_openai_routes(app: web.Application, engine, model_name: str,
         # input_type parity with the NeMo retriever API
         # (reference: embeddings/nemo_embed.py:96-102).
         input_type = body.get("input_type", "query")
-        import asyncio
         loop = asyncio.get_running_loop()
         if input_type == "passage":
             vecs = await loop.run_in_executor(

@@ -8,16 +8,23 @@ from typing import AsyncIterator, Iterator
 _SENTINEL = object()
 
 
-async def iterate_in_thread(it: Iterator[str]) -> AsyncIterator[str]:
+async def iterate_in_thread(it: Iterator[str],
+                            on_cancel=None) -> AsyncIterator[str]:
     """Drive a blocking iterator on the default executor, yielding into the
     event loop with no polling: the producer thread hands each item to an
     asyncio.Queue via ``call_soon_threadsafe``. The producer never blocks
     on a dead consumer (the queue is unbounded; a cancelled consumer flips
     ``done`` and the producer drains out on its next item).
+
+    ``on_cancel`` fires when the consumer abandons the iterator before it
+    is exhausted (e.g. HTTP client disconnect) — pass the engine stream's
+    ``cancel`` so abandoned requests release their decode slot instead of
+    generating to max_tokens (ADVICE.md r1).
     """
     loop = asyncio.get_running_loop()
     q: "asyncio.Queue" = asyncio.Queue()
     done = False
+    exhausted = False
 
     def _put(item) -> None:
         try:
@@ -34,6 +41,15 @@ async def iterate_in_thread(it: Iterator[str]) -> AsyncIterator[str]:
         except BaseException as exc:  # noqa: BLE001 — surface in consumer
             _put(exc)
         finally:
+            # Deterministically close generator chains so abandoned
+            # requests propagate GeneratorExit down to the engine stream
+            # (EngineLLM cancels its request from its finally).
+            close = getattr(it, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:  # noqa: BLE001 — best-effort cleanup
+                    pass
             _put(_SENTINEL)
 
     producer = loop.run_in_executor(None, produce)
@@ -41,10 +57,14 @@ async def iterate_in_thread(it: Iterator[str]) -> AsyncIterator[str]:
         while True:
             item = await q.get()
             if item is _SENTINEL:
+                exhausted = True
                 break
             if isinstance(item, BaseException):
+                exhausted = True
                 raise item
             yield item
     finally:
         done = True
+        if not exhausted and on_cancel is not None:
+            on_cancel()
         await producer

@@ -159,6 +159,75 @@ def test_repetition_penalty_reduces_repeats(engine):
         assert pen.token_ids != plain.token_ids
 
 
+def test_paged_pool_backpressure():
+    """A KV pool smaller than slots x extent must still serve all requests
+    by waiting for pages (the paged-cache capacity-sharing story)."""
+    params = llama.init_params(CFG, jax.random.key(7), dtype=jnp.float32)
+    cfg = EngineConfig(max_slots=4, max_input_length=64, max_output_length=32,
+                       prefill_buckets=(64,), dtype="float32",
+                       page_size=32, kv_pool_tokens=96)  # 3 pages + trash
+    eng = Engine(params, CFG, ByteTokenizer(), cfg)
+    assert eng._n_pages == 4  # 3 usable + trash page 0
+    with eng:
+        # Each request spans 2 pages (prompt ~10 + 32 out = 42 tokens), so
+        # only one fits at a time; all must still complete, in order.
+        streams = [eng.submit(eng.tokenizer.encode(f"backpressure {i}"),
+                              SamplingParams(max_tokens=32, ignore_eos=True))
+                   for i in range(3)]
+        for s in streams:
+            s.text()
+            assert s.finish_reason == "length"
+            assert len(s.token_ids) == 32
+    assert sorted(eng._free_pages) == [1, 2, 3]  # all pages reclaimed
+
+
+def test_paged_pool_floors_at_one_full_request():
+    """Pool sizing floors at one full-extent request, so admission can never
+    deadlock on an accepted request."""
+    params = llama.init_params(CFG, jax.random.key(7), dtype=jnp.float32)
+    cfg = EngineConfig(max_slots=2, max_input_length=64, max_output_length=32,
+                       prefill_buckets=(64,), dtype="float32",
+                       page_size=32, kv_pool_tokens=32)  # asks for 1 page
+    eng = Engine(params, CFG, ByteTokenizer(), cfg)
+    assert eng._n_pages - 1 == eng._pmax  # floored to max_cache_len worth
+    with eng:
+        s = eng.submit([5] * 60, SamplingParams(max_tokens=32,
+                                                ignore_eos=True))
+        s.text()
+        assert s.finish_reason == "length"
+
+
+def test_cancel_releases_slot(engine):
+    stream = engine.submit(engine.tokenizer.encode("cancel me"),
+                           SamplingParams(max_tokens=32, ignore_eos=True))
+    stream.cancel()
+    for _ in iter(stream):
+        pass
+    assert stream.finish_reason == "cancelled"
+    # The engine must keep serving afterwards.
+    ok = engine.submit(engine.tokenizer.encode("after"),
+                       SamplingParams(max_tokens=3, ignore_eos=True))
+    ok.text()
+    assert ok.finish_reason == "length"
+
+
+def test_greedy_parity_engine_vs_engine_small_rounds(engine):
+    """steps_per_round must not affect results: K=1 engine == K=8 engine."""
+    params = engine.params
+    cfg = EngineConfig(max_slots=2, max_input_length=64, max_output_length=32,
+                       prefill_buckets=(16, 32, 64), dtype="float32",
+                       steps_per_round=1, dispatch_depth=1)
+    eng1 = Engine(params, CFG, ByteTokenizer(), cfg)
+    prompt = engine.tokenizer.encode("round parity")
+    sp = SamplingParams(max_tokens=10, top_k=1, ignore_eos=True)
+    with eng1:
+        a = eng1.submit(prompt, sp)
+        a.text()
+    b = engine.submit(prompt, sp)
+    b.text()
+    assert a.token_ids == b.token_ids
+
+
 def test_engine_restarts_after_stop():
     params = llama.init_params(CFG, jax.random.key(7), dtype=jnp.float32)
     eng = Engine(params, CFG, ByteTokenizer(), ENGINE_CFG)
