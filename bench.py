@@ -243,8 +243,15 @@ def main() -> None:
     t_start = time.monotonic()
     skip_e2e = bool(os.environ.get("BENCH_SKIP_E2E"))
     # Embedder first (and only once): the engine's auto-sized KV pool must
-    # account for its memory, and the OOM fallback must not double it.
-    embedder = None if skip_e2e else build_embedder()
+    # account for its memory, and the OOM fallback must not double it. An
+    # embedder failure degrades to engine-only metrics, never aborts.
+    embedder = None
+    if not skip_e2e:
+        try:
+            embedder = build_embedder()
+        except Exception as exc:  # noqa: BLE001
+            sys.stderr.write(f"bench: embedder failed ({exc}); skipping e2e\n")
+            skip_e2e = True
     try:
         engine, model_cfg = build_engine(model, slots, prompt_len)
     except Exception as exc:  # OOM on small chips: degrade, keep the signal

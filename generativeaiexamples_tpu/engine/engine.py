@@ -215,6 +215,7 @@ class Engine:
         self._pending: "queue.Queue[tuple[_Request, SamplingParams]]" = (
             queue.Queue(maxsize=cfg.max_queue))
         self._head: Optional[tuple[_Request, SamplingParams]] = None
+        self._admitting: Optional[_Request] = None  # req in prefill flight
         self._pending_first: list[tuple[_Request, jax.Array]] = []
         self._inflight: deque[tuple[dict[int, _Request], jax.Array]] = deque()
         self._wake = threading.Event()
@@ -419,6 +420,9 @@ class Engine:
         fatal-error fan-out and the stop() drain — a request missed here
         would leave its consumer blocked forever."""
         live: list[_Request] = [r for r, _ in self._pending_first]
+        if self._admitting is not None:  # mid-prefill, not yet in a slot
+            live.append(self._admitting)
+            self._admitting = None
         live += self._slots.values()
         for members, _ in self._inflight:
             live += members.values()
@@ -570,6 +574,7 @@ class Engine:
             if n_alloc > len(self._free_pages):
                 break  # pool backpressure: wait for pages to free up
             self._head = None
+            self._admitting = req  # tracked through the prefill dispatch
             slot = self._free_slots.pop()
             req.slot = slot
             req.pages = [self._free_pages.pop() for _ in range(n_alloc)]
@@ -595,6 +600,7 @@ class Engine:
                 jnp.bool_(not sp.ignore_eos))
             self._bump("prefills")
             self._slots[slot] = req
+            self._admitting = None
             self._pending_first.append((req, first_tok))
             admitted = True
         return admitted
@@ -675,4 +681,5 @@ class Engine:
         self._free_slots.append(req.slot)
         self._free_pages.extend(req.pages)
         req.pages = []
-        req.stream._finish(finish)
+        if not req.done:  # a failed stream keeps its "error" reason
+            req.stream._finish(finish)

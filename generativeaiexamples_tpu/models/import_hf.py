@@ -148,8 +148,11 @@ def _iter_meta_pth(path: str) -> Iterator[tuple[str, np.ndarray]]:
     A single-file checkpoint passes through unchanged."""
     import torch
     files = sorted(f for f in os.listdir(path) if f.endswith((".pth", ".pt")))
+    # mmap keeps the shards page-backed: a 70B checkpoint is 8 x ~17 GB, far
+    # beyond host RAM if loaded eagerly; only the tensors being concatenated
+    # become resident.
     shards = [torch.load(os.path.join(path, f), map_location="cpu",
-                         weights_only=True) for f in files]
+                         weights_only=True, mmap=True) for f in files]
     for key in shards[0]:
         if key == "rope.freqs":  # precomputed buffer, not a weight
             continue

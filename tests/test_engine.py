@@ -228,6 +228,25 @@ def test_greedy_parity_engine_vs_engine_small_rounds(engine):
     assert a.token_ids == b.token_ids
 
 
+def test_crash_during_prefill_fails_stream():
+    """A device error during admission (compile failure, OOM) must fail the
+    request's stream, not leave its consumer blocked forever (regression:
+    the request was untracked between queue pop and slot insert)."""
+    params = llama.init_params(CFG, jax.random.key(7), dtype=jnp.float32)
+    eng = Engine(params, CFG, ByteTokenizer(), ENGINE_CFG)
+
+    def boom(*a, **k):
+        raise RuntimeError("synthetic prefill crash")
+
+    eng._prefill = boom
+    with eng:
+        stream = eng.submit(eng.tokenizer.encode("doomed"),
+                            SamplingParams(max_tokens=4))
+        with pytest.raises(EngineError):
+            stream.text()
+    assert stream.finish_reason == "error"
+
+
 def test_engine_restarts_after_stop():
     params = llama.init_params(CFG, jax.random.key(7), dtype=jnp.float32)
     eng = Engine(params, CFG, ByteTokenizer(), ENGINE_CFG)

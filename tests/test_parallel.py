@@ -93,6 +93,63 @@ def test_tp_sharded_decode_with_cache(cpu_devices):
     assert bool(jnp.isfinite(logits2).all())
 
 
+def test_engine_on_tp_mesh_greedy_parity(cpu_devices):
+    """The full serving engine on a tp=4 mesh (sharded params + paged KV +
+    donated state chain) must reproduce the single-device engine's greedy
+    output exactly — the TP *serving* path, not just the bare forward
+    (VERDICT.md r1 weak #7)."""
+    from generativeaiexamples_tpu.engine import (Engine, EngineConfig,
+                                                 SamplingParams)
+    from generativeaiexamples_tpu.models.tokenizer import ByteTokenizer
+
+    params = llama.init_params(CFG, jax.random.key(3), dtype=jnp.float32)
+    ecfg = EngineConfig(max_slots=2, max_input_length=64, max_output_length=32,
+                        prefill_buckets=(32, 64), dtype="float32",
+                        page_size=32, steps_per_round=4)
+    tok = ByteTokenizer()
+    sp = SamplingParams(max_tokens=8, top_k=1, ignore_eos=True)
+    prompt = tok.encode("mesh parity probe")
+
+    with Engine(params, CFG, tok, ecfg) as single:
+        ref = single.submit(prompt, sp)
+        ref.text()
+
+    mesh = make_mesh(MeshPlan(tp=4), jax.devices()[:4])
+    with Engine(params, CFG, tok, ecfg, mesh=mesh) as sharded_engine:
+        got = sharded_engine.submit(prompt, sp)
+        got.text()
+        # continuous batching on the mesh: a second wave of requests
+        wave = [sharded_engine.submit(tok.encode(f"wave {i}"),
+                                      SamplingParams(max_tokens=3 + i,
+                                                     ignore_eos=True))
+                for i in range(3)]
+        for i, s in enumerate(wave):
+            s.text()
+            assert len(s.token_ids) == 3 + i
+
+    assert got.token_ids == ref.token_ids
+    assert got.finish_reason == "length"
+
+
+def test_engine_on_mesh_gqa_degrade(cpu_devices):
+    """tp=8 > kv_heads=4 through the engine: replicated KV projections,
+    sharded everything else, still generates."""
+    from generativeaiexamples_tpu.engine import (Engine, EngineConfig,
+                                                 SamplingParams)
+    from generativeaiexamples_tpu.models.tokenizer import ByteTokenizer
+
+    params = llama.init_params(CFG, jax.random.key(4), dtype=jnp.float32)
+    ecfg = EngineConfig(max_slots=2, max_input_length=32, max_output_length=16,
+                        prefill_buckets=(32,), dtype="float32", page_size=16,
+                        steps_per_round=2)
+    mesh = make_mesh(MeshPlan(tp=8))
+    with Engine(params, CFG, ByteTokenizer(), ecfg, mesh=mesh) as eng:
+        s = eng.submit(eng.tokenizer.encode("gqa"),
+                       SamplingParams(max_tokens=5, top_k=1, ignore_eos=True))
+        s.text()
+        assert len(s.token_ids) == 5
+
+
 def test_gqa_tp_exceeding_kv_heads_degrades_gracefully(cpu_devices):
     """tp=8 > kv_heads=4: wk/wv fall back to replicated (the XLA version of
     the reference's KV duplication, weight.py:150-157)."""
