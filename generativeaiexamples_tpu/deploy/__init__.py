@@ -1,0 +1,31 @@
+"""Deployment tooling: compose profiles, Helm charts, and the pipeline
+operator.
+
+The reference ships a Go kubebuilder operator ("kube-trailblazer") whose
+HelmPipeline CRD installs an ordered list of Helm charts
+(reference: deploy/k8s-operator/kube-trailblazer/api/v1alpha1/
+helmpipeline_types.go:29-61, controllers/helmpipeline_controller.go:62-116).
+This package provides the same CRD semantics for the TPU stack:
+
+- ``helm``      — chart renderer for the Helm-template subset the first-party
+                  charts use (so ``helm template`` parity is testable in CI
+                  without the helm binary).
+- ``types``     — HelmPipeline/HelmPackage spec types (CRD-compatible).
+- ``kube``      — a thin cluster interface + in-memory fake (the envtest
+                  analogue used by the reference's controller tests,
+                  reference: controllers/suite_test.go:50-60).
+- ``operator``  — the reconciler: ordered install/upgrade, owner labeling,
+                  ConfigMap-backed release state, delete drain, requeue on
+                  error.
+
+The toolchain note: this image has no Go compiler, so the operator is
+implemented in Python against the same CRD; the CRD YAML and chart layout
+stay compatible with a Go/kubebuilder re-implementation.
+"""
+
+from .types import HelmPackage, HelmPipeline
+from .kube import InMemoryKube, KubeInterface
+from .operator import PipelineOperator, ReconcileResult
+
+__all__ = ["HelmPackage", "HelmPipeline", "InMemoryKube", "KubeInterface",
+           "PipelineOperator", "ReconcileResult"]

@@ -31,6 +31,10 @@ class LlamaConfig:
     # MoE (Mixtral): 0 experts = dense MLP.
     num_experts: int = 0
     num_experts_per_tok: int = 2
+    # "sparse" = top-k capacity routing (parallel/moe.py, O(T*k) FLOPs);
+    # "dense" = every expert on every token, zero-gated (O(T*E), no drops).
+    moe_impl: str = "sparse"
+    moe_capacity_factor: float = 2.0
 
     @property
     def q_dim(self) -> int:

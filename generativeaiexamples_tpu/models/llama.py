@@ -135,32 +135,25 @@ def apply_decode_paged(params: Params, cfg: LlamaConfig, tokens: jax.Array,
 
     def layer(h: jax.Array, xs):
         lp, kc, vc = xs  # kc/vc: (N, page, KV, hd)
-        x = rmsnorm(h, lp["attn_norm"], cfg.rms_norm_eps)
-        q = qmm(x, lp["wq"]).reshape(B, S, cfg.num_heads, cfg.head_dim)
-        k = qmm(x, lp["wk"]).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
-        v = qmm(x, lp["wv"]).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
-        q, k = apply_rope(q, k, positions, inv_freq)
-        kc = kc.at[write_page, write_offset].set(k[:, 0].astype(kc.dtype))
-        vc = vc.at[write_page, write_offset].set(v[:, 0].astype(vc.dtype))
-        kg = kc[block_table].reshape(B, P * page, cfg.num_kv_heads,
-                                     cfg.head_dim)
-        vg = vc[block_table].reshape(B, P * page, cfg.num_kv_heads,
-                                     cfg.head_dim)
-        attn = gqa_attention(q, kg, vg, positions, kv_valid_len)
-        h2 = h + qmm(attn.reshape(B, S, cfg.q_dim), lp["wo"])
-        x2 = rmsnorm(h2, lp["mlp_norm"], cfg.rms_norm_eps)
-        mlp = _moe_mlp(x2, lp, cfg) if cfg.num_experts else _dense_mlp(x2, lp)
-        return h2 + mlp, (kc, vc)
+
+        def attend(q, k, v):
+            kc2 = kc.at[write_page, write_offset].set(
+                k[:, 0].astype(kc.dtype))
+            vc2 = vc.at[write_page, write_offset].set(
+                v[:, 0].astype(vc.dtype))
+            kg = kc2[block_table].reshape(B, P * page, cfg.num_kv_heads,
+                                          cfg.head_dim)
+            vg = vc2[block_table].reshape(B, P * page, cfg.num_kv_heads,
+                                          cfg.head_dim)
+            return gqa_attention(q, kg, vg, positions, kv_valid_len), \
+                (kc2, vc2)
+
+        return decoder_layer(h, lp, cfg, positions, inv_freq, kv_valid_len,
+                             attend=attend)
 
     h, (new_k, new_v) = jax.lax.scan(
         layer, h, (params["layers"], kv_cache["k"], kv_cache["v"]))
-    h = rmsnorm(h, params["final_norm"], cfg.rms_norm_eps)
-    head = params.get("lm_head")
-    if head is None:
-        logits = h.astype(jnp.float32) @ params["embed"].T.astype(jnp.float32)
-    else:
-        logits = qmm(h.astype(jnp.float32), head)
-    return logits, {"k": new_k, "v": new_v}
+    return unembed(params, cfg, h), {"k": new_k, "v": new_v}
 
 
 def _dense_mlp(x: jax.Array, lp: dict[str, jax.Array]) -> jax.Array:
@@ -169,9 +162,16 @@ def _dense_mlp(x: jax.Array, lp: dict[str, jax.Array]) -> jax.Array:
 
 
 def _moe_mlp(x: jax.Array, lp: dict[str, jax.Array], cfg: LlamaConfig) -> jax.Array:
-    """Mixtral MLP, dense-compute formulation: every expert runs on every
-    token and the top-k router weights zero out the rest. O(E) FLOPs but
-    fully static — the EP-sharded sparse path is in parallel/moe.py."""
+    """Mixtral MLP. Default is the sparse top-k capacity-routed path
+    (parallel/moe.py, O(tokens*k) expert FLOPs); ``moe_impl="dense"``
+    keeps the zero-gated all-experts formulation (O(tokens*E), no
+    capacity drops) as the parity oracle."""
+    if cfg.moe_impl == "sparse":
+        from ..parallel.moe import sparse_moe_ffn
+        return sparse_moe_ffn(x, lp, cfg)
+    if cfg.moe_impl != "dense":
+        raise ValueError(f"unknown moe_impl {cfg.moe_impl!r}; "
+                         f"expected 'sparse' or 'dense'")
     B, S, D = x.shape
     logits = x @ lp["router"]  # (B,S,E)
     weights, idx = jax.lax.top_k(logits, cfg.num_experts_per_tok)
@@ -184,6 +184,75 @@ def _moe_mlp(x: jax.Array, lp: dict[str, jax.Array], cfg: LlamaConfig) -> jax.Ar
     up = jnp.einsum("bsd,edf->bsef", x, lp["w_up"])
     down = jnp.einsum("bsef,efd->bsed", gate * up, lp["w_down"])
     return jnp.einsum("bsed,bse->bsd", down, gates)
+
+
+def decoder_layer(h: jax.Array, lp: dict[str, jax.Array], cfg: LlamaConfig,
+                  positions: jax.Array, inv_freq: jax.Array,
+                  kv_valid_len: Optional[jax.Array],
+                  cache_kv: Optional[tuple[jax.Array, jax.Array]] = None,
+                  row_start: Optional[jax.Array] = None,
+                  attend=None):
+    """One transformer block. The single source of layer math shared by the
+    full forward (``apply``), the paged decode (``apply_decode_paged``
+    supplies a paged ``attend``), and the pipeline-parallel stage loop
+    (``parallel/pipeline.py``).
+
+    cache_kv: optional (kc, vc) of shape (B, T, KV, hd); new K/V written at
+    ``row_start + offset`` per row. ``attend(q, k, v) -> (attn, new_cache)``
+    overrides the whole KV-write + attention step (used by the paged
+    decode). Returns (h, new_cache_or_None).
+    """
+    B, S, _ = h.shape
+    x = rmsnorm(h, lp["attn_norm"], cfg.rms_norm_eps)
+    q = qmm(x, lp["wq"]).reshape(B, S, cfg.num_heads, cfg.head_dim)
+    k = qmm(x, lp["wk"]).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
+    v = qmm(x, lp["wv"]).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
+    q, k = apply_rope(q, k, positions, inv_freq)
+    if attend is not None:
+        attn, new_cache = attend(q, k, v)
+    elif cache_kv is not None:
+        kc, vc = cache_kv
+        # Write this chunk at its absolute positions (rows contiguous).
+        kc = jax.vmap(
+            lambda c, u, s: jax.lax.dynamic_update_slice(c, u, (s, 0, 0))
+        )(kc, k, row_start)
+        vc = jax.vmap(
+            lambda c, u, s: jax.lax.dynamic_update_slice(c, u, (s, 0, 0))
+        )(vc, v, row_start)
+        attn = gqa_attention(q, kc, vc, positions, kv_valid_len)
+        new_cache = (kc, vc)
+    else:
+        attn = gqa_attention(q, k, v, positions, kv_valid_len)
+        new_cache = None
+    h = h + qmm(attn.reshape(B, S, cfg.q_dim), lp["wo"])
+    x = rmsnorm(h, lp["mlp_norm"], cfg.rms_norm_eps)
+    mlp = _moe_mlp(x, lp, cfg) if cfg.num_experts else _dense_mlp(x, lp)
+    return h + mlp, new_cache
+
+
+def run_layers(layers: dict[str, jax.Array], cfg: LlamaConfig, h: jax.Array,
+               positions: jax.Array,
+               kv_valid_len: Optional[jax.Array] = None) -> jax.Array:
+    """Scan a (possibly partial) stacked layer stack over hidden states,
+    no KV cache — the per-stage body for pipeline parallelism."""
+    inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta,
+                                cfg.rope_scaling_factor)
+
+    def body(h, lp):
+        h, _ = decoder_layer(h, lp, cfg, positions, inv_freq, kv_valid_len)
+        return h, None
+
+    h, _ = jax.lax.scan(body, h, layers)
+    return h
+
+
+def unembed(params: Params, cfg: LlamaConfig, h: jax.Array) -> jax.Array:
+    """Final norm + output projection: (B, S, D) -> (B, S, V) float32."""
+    h = rmsnorm(h, params["final_norm"], cfg.rms_norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        return h.astype(jnp.float32) @ params["embed"].T.astype(jnp.float32)
+    return qmm(h.astype(jnp.float32), head)
 
 
 def apply(params: Params, cfg: LlamaConfig, tokens: jax.Array,
@@ -202,58 +271,28 @@ def apply(params: Params, cfg: LlamaConfig, tokens: jax.Array,
                  causal masking only.
     Returns (logits (B,S,V) or hidden (B,S,D), updated cache or None).
     """
-    B, S = tokens.shape
-    inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta,
-                                cfg.rope_scaling_factor)
     h = jnp.take(params["embed"], tokens, axis=0)
     row_start = positions[:, 0]
     if kv_cache is not None and kv_valid_len is None:
         kv_valid_len = positions[:, -1] + 1
 
-    def qkv(x: jax.Array, lp: dict[str, jax.Array]):
-        q = qmm(x, lp["wq"]).reshape(B, S, cfg.num_heads, cfg.head_dim)
-        k = qmm(x, lp["wk"]).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
-        v = qmm(x, lp["wv"]).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
-        return apply_rope(q, k, positions, inv_freq) + (v,)
-
-    def finish_layer(h: jax.Array, attn: jax.Array, lp: dict[str, jax.Array]):
-        h = h + qmm(attn.reshape(B, S, cfg.q_dim), lp["wo"])
-        x = rmsnorm(h, lp["mlp_norm"], cfg.rms_norm_eps)
-        mlp = _moe_mlp(x, lp, cfg) if cfg.num_experts else _dense_mlp(x, lp)
-        return h + mlp
-
-    def layer_cached(h: jax.Array, xs):
-        lp, kc, vc = xs  # kc/vc: (B,T,KV,hd)
-        q, k, v = qkv(rmsnorm(h, lp["attn_norm"], cfg.rms_norm_eps), lp)
-        # Write this chunk at its absolute positions (rows contiguous).
-        kc = jax.vmap(
-            lambda c, u, s: jax.lax.dynamic_update_slice(c, u, (s, 0, 0))
-        )(kc, k, row_start)
-        vc = jax.vmap(
-            lambda c, u, s: jax.lax.dynamic_update_slice(c, u, (s, 0, 0))
-        )(vc, v, row_start)
-        attn = gqa_attention(q, kc, vc, positions, kv_valid_len)
-        return finish_layer(h, attn, lp), (kc, vc)
-
-    def layer_nocache(h: jax.Array, lp):
-        q, k, v = qkv(rmsnorm(h, lp["attn_norm"], cfg.rms_norm_eps), lp)
-        attn = gqa_attention(q, k, v, positions, kv_valid_len)
-        return finish_layer(h, attn, lp), None
-
     if kv_cache is not None:
+        inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta,
+                                    cfg.rope_scaling_factor)
+
+        def layer_cached(h, xs):
+            lp, kc, vc = xs  # kc/vc: (B,T,KV,hd)
+            h, new_kv = decoder_layer(h, lp, cfg, positions, inv_freq,
+                                      kv_valid_len, (kc, vc), row_start)
+            return h, new_kv
+
         h, (new_k, new_v) = jax.lax.scan(
             layer_cached, h, (params["layers"], kv_cache["k"], kv_cache["v"]))
         new_cache: Optional[KVCache] = {"k": new_k, "v": new_v}
     else:
-        h, _ = jax.lax.scan(layer_nocache, h, params["layers"])
+        h = run_layers(params["layers"], cfg, h, positions, kv_valid_len)
         new_cache = None
 
-    h = rmsnorm(h, params["final_norm"], cfg.rms_norm_eps)
     if return_hidden:
-        return h, new_cache
-    head = params.get("lm_head")
-    if head is None:
-        return h.astype(jnp.float32) @ params["embed"].T.astype(jnp.float32), \
-            new_cache
-    logits = qmm(h.astype(jnp.float32), head)
-    return logits, new_cache
+        return rmsnorm(h, params["final_norm"], cfg.rms_norm_eps), new_cache
+    return unembed(params, cfg, h), new_cache

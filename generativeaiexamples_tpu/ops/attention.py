@@ -2,9 +2,9 @@
 
 Replaces the reference's TRT GPT-attention plugin (reference:
 conversion_scripts/llama/build.py:624-628 ``set_gpt_attention_plugin`` with
-paged KV + remove-input-padding). The jnp implementation here is the
-reference semantics; the Pallas flash/paged kernels in ``flash_attention.py``
-/ ``paged_attention.py`` are drop-in replacements for the hot paths.
+paged KV + remove-input-padding). Paged-KV decode attention lives in
+``models/llama.py:apply_decode_paged`` (page gather + this kernel); XLA
+fuses the masking/softmax chain here into the attention einsums.
 
 Layout conventions (chosen for TPU tiling — head_dim last, 128-aligned):
   q:        (B, S, H,  hd)

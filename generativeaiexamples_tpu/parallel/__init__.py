@@ -10,7 +10,8 @@ and XLA emits the collectives over ICI (DCN across hosts).
 
 from .mesh import AXES, MeshPlan, make_mesh
 from .sharding import (llama_param_specs, shard_params, kv_cache_spec,
-                       activation_spec)
+                       paged_kv_cache_spec, activation_spec)
 
 __all__ = ["AXES", "MeshPlan", "make_mesh", "llama_param_specs",
-           "shard_params", "kv_cache_spec", "activation_spec"]
+           "shard_params", "kv_cache_spec", "paged_kv_cache_spec",
+           "activation_spec"]
