@@ -1,0 +1,114 @@
+"""Operator / deployment CLI.
+
+Commands (the kubebuilder-manager equivalent, reference:
+deploy/k8s-operator/kube-trailblazer/main.go):
+
+  render    <chart-dir> [--set-file values.yaml] [--release NAME]
+            Render a chart to stdout (the ``helm template`` equivalent).
+  reconcile -f pipeline.yaml [--charts PATH] [--dry-run]
+            One reconcile pass of a HelmPipeline manifest.
+  watch     [--charts PATH] [--interval SECONDS]
+            Controller loop: poll HelmPipeline CRs via kubectl, reconcile
+            each (requeue-on-error comes free from the next tick).
+  install-crd
+            kubectl-apply the HelmPipeline CRD.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import yaml
+
+from .helm import load_chart, render_chart
+from .kube import InMemoryKube, KubectlKube
+from .operator import PipelineOperator
+from .types import HelmPipeline
+
+CRD_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "crd", "helmpipeline-crd.yaml")
+
+
+def _cmd_render(args) -> int:
+    chart = load_chart(args.chart)
+    values = {}
+    if args.set_file:
+        with open(args.set_file) as f:
+            values = yaml.safe_load(f) or {}
+    objs = render_chart(chart, args.release, args.namespace, values)
+    print(yaml.safe_dump_all(objs, default_flow_style=False))
+    return 0
+
+
+def _cmd_reconcile(args) -> int:
+    with open(args.file) as f:
+        pipeline = HelmPipeline.from_manifest(yaml.safe_load(f))
+    kube = InMemoryKube() if args.dry_run else KubectlKube()
+    op = PipelineOperator(kube, chart_search_path=args.charts)
+    result = op.reconcile(pipeline)
+    out = {"installed": result.installed, "skipped": result.skipped,
+           "requeue": result.requeue, "error": result.error}
+    if args.dry_run:
+        out["objects"] = sorted("/".join(k) for k in kube.objects)
+    print(json.dumps(out, indent=2))
+    return 1 if result.error else 0
+
+
+def _cmd_watch(args) -> int:
+    kube = KubectlKube()
+    op = PipelineOperator(kube, chart_search_path=args.charts)
+    while True:
+        proc = kube._run(["get", "helmpipelines", "-A", "-o", "json"])
+        if proc.returncode == 0:
+            for item in json.loads(proc.stdout).get("items", []):
+                pipeline = HelmPipeline.from_manifest(item)
+                result = op.reconcile(pipeline)
+                if result.error:
+                    print(f"reconcile {pipeline.name}: requeue "
+                          f"({result.error})", file=sys.stderr)
+        time.sleep(args.interval)
+
+
+def _cmd_install_crd(args) -> int:
+    kube = KubectlKube()
+    with open(CRD_PATH) as f:
+        kube.apply(yaml.safe_load(f))
+    print("HelmPipeline CRD applied")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="generativeaiexamples_tpu.deploy")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("render")
+    p.add_argument("chart")
+    p.add_argument("--set-file", default="")
+    p.add_argument("--release", default="release")
+    p.add_argument("--namespace", default="default")
+    p.set_defaults(fn=_cmd_render)
+
+    p = sub.add_parser("reconcile")
+    p.add_argument("-f", "--file", required=True)
+    p.add_argument("--charts", default="deploy/helm")
+    p.add_argument("--dry-run", action="store_true")
+    p.set_defaults(fn=_cmd_reconcile)
+
+    p = sub.add_parser("watch")
+    p.add_argument("--charts", default="/opt/charts")
+    p.add_argument("--interval", type=int, default=30)
+    p.set_defaults(fn=_cmd_watch)
+
+    p = sub.add_parser("install-crd")
+    p.set_defaults(fn=_cmd_install_crd)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,245 @@
+"""Helm chart renderer for the template subset the first-party charts use.
+
+The reference renders its charts through the Helm Go SDK inside the
+operator (reference: deploy/k8s-operator/kube-trailblazer/pkg/helmer/
+helmer.go:237 ``InstallOrUpgradePackage``). This image has no Go/helm
+binary, so the operator renders charts with this engine instead. The
+supported subset is valid Helm syntax — the charts also render with real
+``helm template`` unchanged:
+
+- ``{{ .Values.a.b }}``, ``{{ .Release.Name }}``, ``{{ .Release.Namespace }}``,
+  ``{{ .Chart.Name }}``, ``{{ .Chart.Version }}``
+- pipes: ``| default <literal>``, ``| quote``, ``| int``, ``| toYaml``,
+  ``| nindent N``
+- blocks: ``{{- if <ref> }} ... {{- else }} ... {{- end }}`` (nestable,
+  truthiness like Helm: absent/None/False/0/""/empty map are false)
+- ``{{- range .Values.list }}`` with ``{{ . }}`` for the element
+
+Charts live as plain directories: ``Chart.yaml``, ``values.yaml``,
+``templates/*.yaml``.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import yaml
+
+from ..utils.errors import ConfigError
+
+
+class ChartError(ConfigError):
+    """Chart loading/rendering failure."""
+
+
+@dataclass
+class Chart:
+    name: str
+    version: str
+    path: str
+    values: dict = field(default_factory=dict)
+    templates: dict[str, str] = field(default_factory=dict)
+
+
+def load_chart(path: str) -> Chart:
+    meta_path = os.path.join(path, "Chart.yaml")
+    if not os.path.isfile(meta_path):
+        raise ChartError(f"no Chart.yaml in {path}")
+    with open(meta_path) as f:
+        meta = yaml.safe_load(f) or {}
+    values: dict = {}
+    vpath = os.path.join(path, "values.yaml")
+    if os.path.isfile(vpath):
+        with open(vpath) as f:
+            values = yaml.safe_load(f) or {}
+    templates: dict[str, str] = {}
+    tdir = os.path.join(path, "templates")
+    if os.path.isdir(tdir):
+        for fname in sorted(os.listdir(tdir)):
+            if fname.endswith((".yaml", ".yml")):
+                with open(os.path.join(tdir, fname)) as f:
+                    templates[fname] = f.read()
+    return Chart(name=str(meta.get("name", os.path.basename(path))),
+                 version=str(meta.get("version", "0.0.0")),
+                 path=path, values=values, templates=templates)
+
+
+def deep_merge(base: dict, override: dict) -> dict:
+    """Helm's values merge: override wins, dicts merge recursively."""
+    out = dict(base)
+    for k, v in (override or {}).items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+_SENTINEL = object()
+
+
+def _lookup(ctx: dict, dotted: str) -> Any:
+    cur: Any = ctx
+    for part in dotted.lstrip(".").split("."):
+        if isinstance(cur, dict) and part in cur:
+            cur = cur[part]
+        else:
+            return _SENTINEL
+    return cur
+
+
+def _truthy(v: Any) -> bool:
+    if v is _SENTINEL or v is None:
+        return False
+    if isinstance(v, (dict, list, str)):
+        return len(v) > 0
+    return bool(v)
+
+
+_PIPE_RE = re.compile(r"\s*\|\s*")
+_TAG_RE = re.compile(r"\{\{-?\s*(.*?)\s*-?\}\}")
+
+
+def _apply_pipe(value: Any, pipe: str) -> Any:
+    pipe = pipe.strip()
+    if pipe.startswith("default "):
+        arg = pipe[len("default "):].strip()
+        literal = yaml.safe_load(arg)
+        return literal if (value is _SENTINEL or value is None) else value
+    if pipe == "quote":
+        v = "" if value in (_SENTINEL, None) else value
+        return '"' + str(v).replace('"', '\\"') + '"'
+    if pipe == "int":
+        return int(value) if value not in (_SENTINEL, None) else 0
+    if pipe == "toYaml":
+        return yaml.safe_dump(value, default_flow_style=False).rstrip()
+    m = re.match(r"nindent (\d+)$", pipe)
+    if m:
+        pad = " " * int(m.group(1))
+        text = "" if value in (_SENTINEL, None) else str(value)
+        return "\n" + "\n".join(pad + line for line in text.splitlines())
+    raise ChartError(f"unsupported template pipe {pipe!r}")
+
+
+def _eval_expr(expr: str, ctx: dict) -> Any:
+    parts = _PIPE_RE.split(expr)
+    head = parts[0].strip()
+    if head.startswith("."):
+        value = _lookup(ctx, head)
+    else:
+        value = yaml.safe_load(head)  # literal
+    for pipe in parts[1:]:
+        value = _apply_pipe(value, pipe)
+    if value is _SENTINEL:
+        raise ChartError(f"unresolved template reference {head!r}")
+    return value
+
+
+@dataclass
+class _Block:
+    kind: str            # "text" | "expr" | "if" | "range"
+    payload: Any = None
+    children: list = field(default_factory=list)
+    alt: list = field(default_factory=list)   # else branch
+
+
+def _parse(src: str) -> list[_Block]:
+    """Parse template source into a block tree."""
+    blocks: list[_Block] = []
+    stack: list[_Block] = []
+
+    def emit(b: _Block) -> None:
+        (stack[-1].alt if stack and getattr(stack[-1], "_in_else", False)
+         else stack[-1].children if stack else blocks).append(b)
+
+    pos = 0
+    for m in _TAG_RE.finditer(src):
+        text = src[pos:m.start()]
+        # trim semantics: "{{-" eats preceding whitespace+newline
+        if m.group(0).startswith("{{-"):
+            text = text.rstrip(" \t")
+            if text.endswith("\n"):
+                text = text[:-1]
+        if text:
+            emit(_Block("text", text))
+        tag = m.group(1)
+        if tag.startswith("if "):
+            b = _Block("if", tag[3:].strip())
+            emit(b)
+            stack.append(b)
+        elif tag == "else":
+            if not stack or stack[-1].kind != "if":
+                raise ChartError("'else' outside if")
+            stack[-1]._in_else = True  # type: ignore[attr-defined]
+        elif tag.startswith("range "):
+            b = _Block("range", tag[6:].strip())
+            emit(b)
+            stack.append(b)
+        elif tag == "end":
+            if not stack:
+                raise ChartError("'end' without open block")
+            stack.pop()
+        else:
+            emit(_Block("expr", tag))
+        pos = m.end()
+        if m.group(0).endswith("-}}"):
+            while pos < len(src) and src[pos] in " \t":
+                pos += 1
+            if pos < len(src) and src[pos] == "\n":
+                pos += 1
+    if src[pos:]:
+        emit(_Block("text", src[pos:]))
+    if stack:
+        raise ChartError("unclosed template block")
+    return blocks
+
+
+def _render_blocks(blocks: list[_Block], ctx: dict) -> str:
+    out: list[str] = []
+    for b in blocks:
+        if b.kind == "text":
+            out.append(b.payload)
+        elif b.kind == "expr":
+            out.append(str(_eval_expr(b.payload, ctx)))
+        elif b.kind == "if":
+            cond = _lookup(ctx, b.payload) if b.payload.startswith(".") \
+                else yaml.safe_load(b.payload)
+            branch = b.children if _truthy(cond) else b.alt
+            out.append(_render_blocks(branch, ctx))
+        elif b.kind == "range":
+            items = _lookup(ctx, b.payload)
+            if items is _SENTINEL or items is None:
+                items = []
+            for item in items:
+                sub = dict(ctx)
+                sub[""] = item  # "{{ . }}" resolves via the "" key
+                out.append(_render_blocks(b.children, sub))
+    return "".join(out)
+
+
+def render_chart(chart: Chart, release_name: str, namespace: str = "default",
+                 values: Optional[dict] = None) -> list[dict]:
+    """Render every template with merged values; returns parsed manifests
+    (the ``helm template`` equivalent)."""
+    merged = deep_merge(chart.values, values or {})
+    ctx = {
+        "Values": merged,
+        "Release": {"Name": release_name, "Namespace": namespace},
+        "Chart": {"Name": chart.name, "Version": chart.version},
+    }
+    objects: list[dict] = []
+    for fname, src in chart.templates.items():
+        try:
+            text = _render_blocks(_parse(src), ctx)
+        except ChartError as exc:
+            raise ChartError(f"{chart.name}/templates/{fname}: {exc}") from exc
+        for doc in yaml.safe_load_all(text):
+            if isinstance(doc, dict) and doc:
+                objects.append(doc)
+            elif doc not in (None, ""):
+                raise ChartError(
+                    f"{chart.name}/templates/{fname}: non-mapping manifest")
+    return objects

@@ -1,0 +1,125 @@
+"""Thin cluster interface + in-memory fake.
+
+The reconciler only needs apply/get/delete/list-by-label; real clusters get
+a kubectl-backed client, tests get ``InMemoryKube`` — the same fake-client
+testing strategy the reference uses (reference:
+pkg/clients/clients_test.go ``fake.NewClientBuilder`` and the envtest
+scaffold in controllers/suite_test.go:50-60; no cluster required).
+"""
+
+from __future__ import annotations
+
+import abc
+import json
+import subprocess
+from typing import Iterable, Optional
+
+ObjKey = tuple[str, str, str, str]  # (apiVersion, kind, namespace, name)
+
+
+def obj_key(obj: dict) -> ObjKey:
+    meta = obj.get("metadata", {})
+    return (str(obj.get("apiVersion", "")), str(obj.get("kind", "")),
+            str(meta.get("namespace", "default")), str(meta.get("name", "")))
+
+
+def key_str(key: ObjKey) -> str:
+    return "/".join(key)
+
+
+class KubeInterface(abc.ABC):
+    """What the reconciler needs from a cluster."""
+
+    @abc.abstractmethod
+    def apply(self, obj: dict) -> None:
+        """Create or update (server-side-apply semantics)."""
+
+    @abc.abstractmethod
+    def get(self, key: ObjKey) -> Optional[dict]:
+        ...
+
+    @abc.abstractmethod
+    def delete(self, key: ObjKey) -> bool:
+        """Delete; False if absent."""
+
+    @abc.abstractmethod
+    def list_labeled(self, label: str, value: str) -> list[dict]:
+        """All objects carrying label=value."""
+
+
+class InMemoryKube(KubeInterface):
+    """Dict-backed fake cluster; records event order for assertions."""
+
+    def __init__(self):
+        self.objects: dict[ObjKey, dict] = {}
+        self.events: list[tuple[str, str]] = []   # (verb, key)
+
+    def apply(self, obj: dict) -> None:
+        key = obj_key(obj)
+        verb = "update" if key in self.objects else "create"
+        self.objects[key] = json.loads(json.dumps(obj))  # deep copy
+        self.events.append((verb, key_str(key)))
+
+    def get(self, key: ObjKey) -> Optional[dict]:
+        return self.objects.get(key)
+
+    def delete(self, key: ObjKey) -> bool:
+        self.events.append(("delete", key_str(key)))
+        return self.objects.pop(key, None) is not None
+
+    def list_labeled(self, label: str, value: str) -> list[dict]:
+        return [o for o in self.objects.values()
+                if o.get("metadata", {}).get("labels", {}).get(label) == value]
+
+
+class KubectlKube(KubeInterface):
+    """kubectl-backed client for real clusters (no python k8s client in the
+    image). Each call shells out; suitable for operator CLI use."""
+
+    def __init__(self, kubectl: str = "kubectl"):
+        self.kubectl = kubectl
+
+    def _run(self, args: list[str], stdin: Optional[str] = None
+             ) -> subprocess.CompletedProcess:
+        return subprocess.run([self.kubectl, *args], input=stdin,
+                              capture_output=True, text=True, timeout=120)
+
+    def apply(self, obj: dict) -> None:
+        proc = self._run(["apply", "-f", "-"], stdin=json.dumps(obj))
+        if proc.returncode != 0:
+            raise RuntimeError(f"kubectl apply failed: {proc.stderr}")
+
+    def get(self, key: ObjKey) -> Optional[dict]:
+        _, kind, ns, name = key
+        proc = self._run(["get", kind, name, "-n", ns, "-o", "json"])
+        return json.loads(proc.stdout) if proc.returncode == 0 else None
+
+    def delete(self, key: ObjKey) -> bool:
+        _, kind, ns, name = key
+        return self._run(["delete", kind, name, "-n", ns,
+                          "--ignore-not-found"]).returncode == 0
+
+    def list_labeled(self, label: str, value: str) -> list[dict]:
+        proc = self._run(["get", "all", "-A", "-l", f"{label}={value}",
+                          "-o", "json"])
+        if proc.returncode != 0:
+            return []
+        return json.loads(proc.stdout).get("items", [])
+
+
+def ensure_labels(obj: dict, labels: dict[str, str]) -> dict:
+    """Return obj with labels merged in (the owner-label post-renderer of
+    the reference, helmer.go:270-305)."""
+    meta = obj.setdefault("metadata", {})
+    meta.setdefault("labels", {}).update(labels)
+    return obj
+
+
+def drain_order(objects: Iterable[dict]) -> list[dict]:
+    """Deletion order: workloads first, then services/config, then RBAC —
+    the reference's delete-stack drain (helmpipeline_controller.go:75-94)."""
+    rank = {"Deployment": 0, "StatefulSet": 0, "DaemonSet": 0, "Job": 0,
+            "Pod": 0, "Service": 1, "ConfigMap": 2, "Secret": 2,
+            "PersistentVolumeClaim": 3, "ServiceAccount": 4, "Role": 4,
+            "RoleBinding": 4, "ClusterRole": 4, "ClusterRoleBinding": 4}
+    return sorted(objects, key=lambda o: rank.get(o.get("kind", ""), 2))

@@ -1,0 +1,147 @@
+"""The HelmPipeline reconciler.
+
+Reconcile semantics match the reference controller
+(reference: controllers/helmpipeline_controller.go:62-116):
+- install/upgrade each package of the pipeline **in order**;
+- every rendered object gets the owned-by label before it reaches the
+  cluster (reference: helmer.go:270-305 owner-ref post-renderer);
+- release state (chart, version, manifest hash, object keys) persists in a
+  ConfigMap per pipeline (reference: pkg/storage/storage.go:16-108);
+- unchanged releases (same chart+values hash) are skipped — upgrade only
+  applies diffs;
+- objects that belonged to a release but are gone from the new rendering
+  are pruned; deleting a pipeline drains every owned object, workloads
+  first (reference: controllers/helmpipeline_controller.go:75-94);
+- any package error aborts the walk and returns requeue=True
+  (reference: helmpipeline_controller.go:104-107).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+from urllib.parse import urlparse
+
+from .helm import ChartError, load_chart, render_chart
+from .kube import (KubeInterface, drain_order, ensure_labels, key_str,
+                   obj_key)
+from .types import OWNED_BY_LABEL, HelmPipeline, ReleaseState
+
+logger = logging.getLogger("tpu-rag.operator")
+
+
+@dataclass
+class ReconcileResult:
+    requeue: bool = False
+    error: Optional[str] = None
+    installed: list[str] = field(default_factory=list)
+    skipped: list[str] = field(default_factory=list)
+
+
+class PipelineOperator:
+    """Reconciles HelmPipeline specs against a cluster interface."""
+
+    def __init__(self, kube: KubeInterface, chart_search_path: str = ""):
+        self.kube = kube
+        self.chart_search_path = chart_search_path
+
+    # ------------------------------------------------------------- charts
+
+    def _chart_dir(self, pkg) -> str:
+        url = urlparse(pkg.repo_url)
+        if url.scheme in ("file", ""):
+            base = url.path or pkg.repo_url
+            candidate = os.path.join(base, pkg.chart_name)
+            if os.path.isdir(candidate):
+                return candidate
+        if self.chart_search_path:
+            candidate = os.path.join(self.chart_search_path, pkg.chart_name)
+            if os.path.isdir(candidate):
+                return candidate
+        raise ChartError(
+            f"chart {pkg.chart_name!r} not found under {pkg.repo_url!r} "
+            f"or search path {self.chart_search_path!r} (network chart "
+            f"repos are not reachable from an air-gapped TPU pod)")
+
+    # -------------------------------------------------------------- state
+
+    def _state_key(self, pipeline: HelmPipeline):
+        return ("v1", "ConfigMap", pipeline.namespace,
+                f"helmpipeline-{pipeline.name}-state")
+
+    def _load_state(self, pipeline: HelmPipeline) -> dict[str, ReleaseState]:
+        cm = self.kube.get(self._state_key(pipeline))
+        if not cm:
+            return {}
+        out = {}
+        for release, blob in (cm.get("data") or {}).items():
+            d = json.loads(blob)
+            out[release] = ReleaseState(**d)
+        return out
+
+    def _save_state(self, pipeline: HelmPipeline,
+                    state: dict[str, ReleaseState]) -> None:
+        api, kind, ns, name = self._state_key(pipeline)
+        self.kube.apply({
+            "apiVersion": api, "kind": kind,
+            "metadata": {"name": name, "namespace": ns,
+                         "labels": {OWNED_BY_LABEL: pipeline.name}},
+            "data": {rel: json.dumps(vars(st))
+                     for rel, st in state.items()},
+        })
+
+    # ---------------------------------------------------------- reconcile
+
+    def reconcile(self, pipeline: HelmPipeline) -> ReconcileResult:
+        result = ReconcileResult()
+        state = self._load_state(pipeline)
+        for pkg in pipeline.packages:
+            try:
+                chart = load_chart(self._chart_dir(pkg))
+                objects = render_chart(chart, pkg.release, pkg.namespace,
+                                       pkg.values)
+                blob = json.dumps(objects, sort_keys=True).encode()
+                manifest_hash = hashlib.sha256(blob).hexdigest()
+                prev = state.get(pkg.release)
+                if prev and prev.manifest_hash == manifest_hash:
+                    result.skipped.append(pkg.release)
+                    continue
+                keys = []
+                for obj in objects:
+                    ensure_labels(obj, {OWNED_BY_LABEL: pipeline.name})
+                    obj.setdefault("metadata", {}).setdefault(
+                        "namespace", pkg.namespace)
+                    self.kube.apply(obj)
+                    keys.append(key_str(obj_key(obj)))
+                if prev:  # prune objects dropped by the new rendering
+                    for stale in set(prev.object_keys) - set(keys):
+                        self.kube.delete(tuple(stale.split("/")))  # type: ignore[arg-type]
+                state[pkg.release] = ReleaseState(
+                    release=pkg.release, chart=chart.name,
+                    version=chart.version, manifest_hash=manifest_hash,
+                    object_keys=keys)
+                result.installed.append(pkg.release)
+                logger.info("installed release %s (%s-%s)", pkg.release,
+                            chart.name, chart.version)
+            except Exception as exc:  # noqa: BLE001 — requeue semantics
+                logger.exception("reconcile failed at release %s",
+                                 pkg.release)
+                result.requeue = True
+                result.error = f"{pkg.release}: {exc}"
+                break
+        self._save_state(pipeline, state)
+        return result
+
+    def delete(self, pipeline: HelmPipeline) -> int:
+        """Drain every object owned by this pipeline (workloads first).
+        Returns the number of deleted objects."""
+        owned = self.kube.list_labeled(OWNED_BY_LABEL, pipeline.name)
+        n = 0
+        for obj in drain_order(owned):
+            n += bool(self.kube.delete(obj_key(obj)))
+        self.kube.delete(self._state_key(pipeline))
+        return n

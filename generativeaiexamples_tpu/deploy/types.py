@@ -1,0 +1,97 @@
+"""HelmPipeline spec types — CRD-compatible with the reference operator.
+
+The reference CRD (group ``package.nvidia.com``, kind ``HelmPipeline``) is
+an ordered list of Helm packages, each naming a repo, chart, version, and
+values (reference: api/v1alpha1/helmpipeline_types.go:29-61,
+pkg/helmer/types.go:137-150). Same shape here under the
+``package.tpu-rag.dev`` group; ``repoUrl`` may be a ``file://`` chart
+directory (the air-gapped default for the first-party charts).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+GROUP = "package.tpu-rag.dev"
+VERSION = "v1alpha1"
+API_VERSION = f"{GROUP}/{VERSION}"
+KIND = "HelmPipeline"
+OWNED_BY_LABEL = "app.tpu-rag.dev/owned-by"
+
+
+@dataclass
+class HelmPackage:
+    """One chart install within a pipeline (ordered)."""
+    repo_name: str
+    repo_url: str                  # file:///abs/path/to/charts or https://...
+    chart_name: str
+    chart_version: str = ""
+    namespace: str = "default"
+    release_name: str = ""         # defaults to chart_name
+    values: dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def release(self) -> str:
+        return self.release_name or self.chart_name
+
+    @classmethod
+    def from_spec(cls, spec: dict) -> "HelmPackage":
+        return cls(
+            repo_name=spec.get("repoName", ""),
+            repo_url=spec.get("repoUrl", ""),
+            chart_name=spec.get("chartName", ""),
+            chart_version=spec.get("chartVersion", ""),
+            namespace=spec.get("namespace", "default"),
+            release_name=spec.get("releaseName", ""),
+            values=spec.get("chartValues", {}) or {},
+        )
+
+
+@dataclass
+class HelmPipeline:
+    """The CR: metadata + ordered package list."""
+    name: str
+    namespace: str = "default"
+    packages: list[HelmPackage] = field(default_factory=list)
+    generation: int = 1
+
+    @classmethod
+    def from_manifest(cls, obj: dict) -> "HelmPipeline":
+        meta = obj.get("metadata", {})
+        spec = obj.get("spec", {})
+        pkgs = [HelmPackage.from_spec(p.get("helmPackage", p))
+                for p in spec.get("pipeline", [])]
+        return cls(name=meta.get("name", ""),
+                   namespace=meta.get("namespace", "default"),
+                   packages=pkgs,
+                   generation=int(meta.get("generation", 1)))
+
+    def to_manifest(self) -> dict:
+        return {
+            "apiVersion": API_VERSION,
+            "kind": KIND,
+            "metadata": {"name": self.name, "namespace": self.namespace,
+                         "generation": self.generation},
+            "spec": {"pipeline": [{
+                "helmPackage": {
+                    "repoName": p.repo_name,
+                    "repoUrl": p.repo_url,
+                    "chartName": p.chart_name,
+                    "chartVersion": p.chart_version,
+                    "namespace": p.namespace,
+                    "releaseName": p.release_name,
+                    "chartValues": p.values,
+                }} for p in self.packages]},
+        }
+
+
+@dataclass
+class ReleaseState:
+    """Installed-release record (the ConfigMap-backed state of the
+    reference's pkg/storage/storage.go:16-108)."""
+    release: str
+    chart: str
+    version: str
+    manifest_hash: str
+    object_keys: list[str] = field(default_factory=list)
