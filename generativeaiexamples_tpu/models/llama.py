@@ -125,6 +125,13 @@ def apply_decode_paged(params: Params, cfg: LlamaConfig, tokens: jax.Array,
     capacity). write_page/write_offset: (B,) physical destination of this
     step's K/V (page 0 = trash for inactive slots). Returns
     (logits (B, 1, V), updated cache).
+
+    Memory discipline: the layer scan only READS the pool; each layer's new
+    K/V (tiny) is collected as a scan output and the pool is updated with
+    ONE in-place scatter afterwards. Routing the pool itself through the
+    scan as sliced-xs/stacked-ys would make XLA materialize a second full
+    copy of the pool as loop temporaries — 2x pool HBM, the round-2 bench
+    OOM. The current token instead rides the gathered attention window.
     """
     B, S = tokens.shape
     P = block_table.shape[1]
@@ -132,28 +139,37 @@ def apply_decode_paged(params: Params, cfg: LlamaConfig, tokens: jax.Array,
     inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta,
                                 cfg.rope_scaling_factor)
     h = jnp.take(params["embed"], tokens, axis=0)
+    pos_in_win = positions[:, 0]  # logical index of the current token
+    rows = jnp.arange(B)
 
     def layer(h: jax.Array, xs):
-        lp, kc, vc = xs  # kc/vc: (N, page, KV, hd)
+        lp, kc, vc = xs  # kc/vc: (N, page, KV, hd) — read-only here
 
         def attend(q, k, v):
-            kc2 = kc.at[write_page, write_offset].set(
-                k[:, 0].astype(kc.dtype))
-            vc2 = vc.at[write_page, write_offset].set(
-                v[:, 0].astype(vc.dtype))
-            kg = kc2[block_table].reshape(B, P * page, cfg.num_kv_heads,
-                                          cfg.head_dim)
-            vg = vc2[block_table].reshape(B, P * page, cfg.num_kv_heads,
-                                          cfg.head_dim)
+            kg = kc[block_table].reshape(B, P * page, cfg.num_kv_heads,
+                                         cfg.head_dim)
+            vg = vc[block_table].reshape(B, P * page, cfg.num_kv_heads,
+                                         cfg.head_dim)
+            # Current token joins the window in-register (its pool write
+            # happens in the post-scan scatter).
+            kg = kg.at[rows, pos_in_win].set(k[:, 0].astype(kg.dtype))
+            vg = vg.at[rows, pos_in_win].set(v[:, 0].astype(vg.dtype))
             return gqa_attention(q, kg, vg, positions, kv_valid_len), \
-                (kc2, vc2)
+                (k[:, 0], v[:, 0])
 
         return decoder_layer(h, lp, cfg, positions, inv_freq, kv_valid_len,
                              attend=attend)
 
     h, (new_k, new_v) = jax.lax.scan(
         layer, h, (params["layers"], kv_cache["k"], kv_cache["v"]))
-    return unembed(params, cfg, h), {"k": new_k, "v": new_v}
+    # new_k/new_v: (L, B, KV, hd) -> one scatter into the (donated) pool.
+    cache = {
+        "k": kv_cache["k"].at[:, write_page, write_offset].set(
+            new_k.astype(kv_cache["k"].dtype)),
+        "v": kv_cache["v"].at[:, write_page, write_offset].set(
+            new_v.astype(kv_cache["v"].dtype)),
+    }
+    return unembed(params, cfg, h), cache
 
 
 def _dense_mlp(x: jax.Array, lp: dict[str, jax.Array]) -> jax.Array:

@@ -1,0 +1,12 @@
+"""Evaluation + data tooling (the reference's ``tools/`` tier).
+
+``tools.eval`` is the script-form replacement for the reference's
+4-notebook evaluation pipeline (reference: tools/evaluation/
+01_synthetic_data_generation.ipynb -> 02_filling_RAG_outputs ->
+03_eval_ragas.ipynb -> 04_Human_Like_RAG_Evaluation-AIP.ipynb):
+synthetic QA generation from the knowledge base, RAG answer/context
+filling, RAGAS-style faithfulness and context-precision, retrieval
+nDCG/hit-rate/MRR, and an LLM-judge Likert loop — runnable headless in CI
+(``python -m generativeaiexamples_tpu.tools.eval``) as well as against a
+live serving stack.
+"""
