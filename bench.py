@@ -130,10 +130,21 @@ def run_engine_bench(engine, prompt_len: int, out_len: int, n_requests: int,
     prompt_ids = prompt_ids[:prompt_len]
     sp = SamplingParams(max_tokens=out_len, top_k=1, ignore_eos=True)
 
-    # Warmup: compile prefill/insert/decode-round for this geometry.
+    # Warmup: compile prefill/insert/decode-round for this geometry —
+    # including every right-sized tail round (steps ladder, powers of two
+    # up to steps_per_round) — so the measured phases never hit a compile.
     engine.start()
     engine.submit(prompt_ids, SamplingParams(max_tokens=out_len, top_k=1,
                                              ignore_eos=True)).text()
+    steps = engine.cfg.steps_per_round
+    ladder = []
+    s = 1
+    while s < steps:
+        ladder.append(s)
+        s *= 2
+    for s in ladder:  # max_tokens=s+1 -> a final round of exactly s steps
+        engine.submit(prompt_ids, SamplingParams(
+            max_tokens=s + 1, top_k=1, ignore_eos=True)).text()
 
     # TTFT: sequential requests against an idle engine (the reference's
     # single-user chat scenario).
@@ -238,10 +249,14 @@ def run_e2e_bench(engine, embedder, n_requests: int) -> float:
     url = f"http://127.0.0.1:{port_holder['port']}/generate"
 
     def one_ttft() -> float:
+        # num_tokens bounds the overestimate: with random weights the
+        # detokenizer often withholds everything until the final flush
+        # (no valid UTF-8), so first-byte time degenerates to completion
+        # time. Real checkpoints stream normally.
         t0 = time.monotonic()
         with requests.post(url, json={
                 "question": "What does the MXU do and how big is it?",
-                "use_knowledge_base": True, "num_tokens": 64},
+                "use_knowledge_base": True, "num_tokens": 16},
                 stream=True, timeout=300) as resp:
             resp.raise_for_status()
             # First byte, or EOF for a zero-visible-token generation
@@ -287,7 +302,7 @@ def main() -> None:
         rungs.append((model, "int8"))
     if model != "llama-1b":
         rungs.append(("llama-1b", "int8"))
-    last_exc = None
+    last_err = None
     for rung_model, rung_quant in rungs:
         engine = None
         try:
@@ -298,18 +313,23 @@ def main() -> None:
             model, quant = rung_model, rung_quant
             break
         except Exception as exc:  # noqa: BLE001 - degrade, keep the signal
-            last_exc = exc
-            sys.stderr.write(
-                f"bench: {rung_model}/{rung_quant} failed "
-                f"({type(exc).__name__}: {exc}); degrading\n")
+            # Keep only the message: the exception's traceback pins the
+            # failed engine (params + KV pool) in memory, which would OOM
+            # the next rung too.
+            last_err = f"{type(exc).__name__}: {exc}"
+            sys.stderr.write(f"bench: {rung_model}/{rung_quant} failed "
+                             f"({last_err}); degrading\n")
             if engine is not None:
                 try:
                     engine.stop()
                 except Exception:  # noqa: BLE001
                     pass
             engine = None
+            del exc
+            import gc
+            gc.collect()
     if engine is None:
-        raise SystemExit(f"bench: all rungs failed: {last_exc}")
+        raise SystemExit(f"bench: all rungs failed: {last_err}")
 
     try:
         achieved_bw, bw_util = hbm_utilization(engine, model_cfg, tput, slots,

@@ -37,11 +37,37 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 
+import os
+
 from ..ops.attention import gqa_attention
 from ..ops.quant import matmul as qmm
 from ..ops.rmsnorm import rmsnorm
 from ..ops.rope import apply_rope, rope_frequencies
 from .configs import LlamaConfig
+
+
+def use_paged_kernel(cfg: LlamaConfig, page: int) -> bool:
+    """Public alias: whether the Pallas paged-attention decode kernel will
+    be used for this config (the engine pins pool layouts accordingly)."""
+    return _use_paged_kernel(cfg, page)
+
+
+def _use_paged_kernel(cfg: LlamaConfig, page: int) -> bool:
+    """Pallas paged-attention gate: on TPU backends with kernel-supported
+    geometry (lane-aligned head_dim/page), unless disabled via
+    GENAI_TPU_PAGED_KERNEL=0. Other backends take the jnp gather path."""
+    flag = os.environ.get("GENAI_TPU_PAGED_KERNEL", "auto")
+    if flag == "0":
+        return False
+    from ..ops.paged_attention import kernel_supported
+    ok = kernel_supported(page, cfg.num_heads, cfg.num_kv_heads,
+                          cfg.head_dim)
+    if flag == "1":
+        return ok
+    try:
+        return ok and jax.default_backend() == "tpu"
+    except Exception:
+        return False
 
 Params = dict[str, Any]
 KVCache = dict[str, jax.Array]  # {"k": (L,B,T,KV,hd), "v": (L,B,T,KV,hd)}
@@ -99,15 +125,20 @@ def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int,
 
 def init_paged_kv_cache(cfg: LlamaConfig, n_pages: int, page_size: int,
                         dtype: jnp.dtype = jnp.bfloat16) -> KVCache:
-    """Block-pool KV cache: {"k","v"}: (L, n_pages, page, KV, hd).
+    """Block-pool KV cache: {"k","v"}: (L, n_pages, KV, page, hd).
 
     The pool is shared by all decode slots through per-slot block tables —
     the XLA-static equivalent of TRT-LLM's paged KV cache
     (reference: ensemble_models/llama/tensorrt_llm/config.pbtxt.j2:28-34).
     Page 0 is reserved as a trash page: writes for inactive slots and
     prefill-bucket overhang are routed there.
+
+    Layout: KV heads ahead of the page dim so a page block arrives in VMEM
+    as (KV, page, hd) — exactly the batched-matmul operand shape the Pallas
+    decode kernel consumes, with (page, hd) on the tiled lanes and no
+    in-kernel transpose.
     """
-    shape = (cfg.num_layers, n_pages, page_size, cfg.num_kv_heads,
+    shape = (cfg.num_layers, n_pages, cfg.num_kv_heads, page_size,
              cfg.head_dim)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
@@ -116,6 +147,7 @@ def apply_decode_paged(params: Params, cfg: LlamaConfig, tokens: jax.Array,
                        positions: jax.Array, kv_cache: KVCache,
                        block_table: jax.Array, kv_valid_len: jax.Array,
                        write_page: jax.Array, write_offset: jax.Array,
+                       use_kernel: Optional[bool] = None,
                        ) -> tuple[jax.Array, KVCache]:
     """Single-token decode step over the paged KV pool.
 
@@ -135,23 +167,55 @@ def apply_decode_paged(params: Params, cfg: LlamaConfig, tokens: jax.Array,
     """
     B, S = tokens.shape
     P = block_table.shape[1]
-    page = kv_cache["k"].shape[2]
+    page = kv_cache["k"].shape[3]  # (L, N, KV, page, hd)
     inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta,
                                 cfg.rope_scaling_factor)
     h = jnp.take(params["embed"], tokens, axis=0)
     pos_in_win = positions[:, 0]  # logical index of the current token
     rows = jnp.arange(B)
 
+    # use_kernel: the caller (engine) decides — the Pallas path has no
+    # SPMD partitioning rule, so mesh/TP serving must take the jnp path.
+    # None = auto for single-device callers.
+    if use_kernel is None:
+        use_kernel = _use_paged_kernel(cfg, page)
+    if use_kernel:
+        # Kernel path: the pools ride the scan CARRY and pass through the
+        # Pallas call aliased in place (attention read + row append happen
+        # inside the kernel). No XLA gather/scatter ever touches the pool,
+        # so no layout fights and no carry double-buffering.
+        from ..ops.paged_attention import paged_attention_decode
+        dt = kv_cache["k"].dtype
+
+        def layer_k(carry, lp):
+            h, pk, pv, li = carry
+
+            def attend(q, k, v):
+                attn, pk2, pv2 = paged_attention_decode(
+                    q[:, 0], pk, pv, block_table, pos_in_win,
+                    k[:, 0].astype(dt), v[:, 0].astype(dt),
+                    write_page, write_offset, li)
+                return attn[:, None], (pk2, pv2)
+
+            h, (pk, pv) = decoder_layer(h, lp, cfg, positions, inv_freq,
+                                        kv_valid_len, attend=attend)
+            return (h, pk, pv, li + 1), None
+
+        (h, pk, pv, _), _ = jax.lax.scan(
+            layer_k, (h, kv_cache["k"], kv_cache["v"],
+                      jnp.zeros((1,), jnp.int32)), params["layers"])
+        return unembed(params, cfg, h), {"k": pk, "v": pv}
+
     def layer(h: jax.Array, xs):
-        lp, kc, vc = xs  # kc/vc: (N, page, KV, hd) — read-only here
+        lp, kc, vc = xs  # kc/vc: (N, KV, page, hd) — read-only here
 
         def attend(q, k, v):
-            kg = kc[block_table].reshape(B, P * page, cfg.num_kv_heads,
-                                         cfg.head_dim)
-            vg = vc[block_table].reshape(B, P * page, cfg.num_kv_heads,
-                                         cfg.head_dim)
-            # Current token joins the window in-register (its pool write
-            # happens in the post-scan scatter).
+            kg = kc[block_table].swapaxes(2, 3).reshape(
+                B, P * page, cfg.num_kv_heads, cfg.head_dim)
+            vg = vc[block_table].swapaxes(2, 3).reshape(
+                B, P * page, cfg.num_kv_heads, cfg.head_dim)
+            # Current token joins the window in-register (its pool
+            # write happens in the post-scan scatter).
             kg = kg.at[rows, pos_in_win].set(k[:, 0].astype(kg.dtype))
             vg = vg.at[rows, pos_in_win].set(v[:, 0].astype(vg.dtype))
             return gqa_attention(q, kg, vg, positions, kv_valid_len), \
@@ -163,12 +227,19 @@ def apply_decode_paged(params: Params, cfg: LlamaConfig, tokens: jax.Array,
     h, (new_k, new_v) = jax.lax.scan(
         layer, h, (params["layers"], kv_cache["k"], kv_cache["v"]))
     # new_k/new_v: (L, B, KV, hd) -> one scatter into the (donated) pool.
-    cache = {
-        "k": kv_cache["k"].at[:, write_page, write_offset].set(
-            new_k.astype(kv_cache["k"].dtype)),
-        "v": kv_cache["v"].at[:, write_page, write_offset].set(
-            new_v.astype(kv_cache["v"].dtype)),
-    }
+    # Flattening (N, KV, page) into one dim keeps the scatter single-axis
+    # and layout-neutral.
+    L_, N_, KV_, page_, hd_ = kv_cache["k"].shape
+    flat_idx = ((write_page[:, None] * KV_ + jnp.arange(KV_)[None, :])
+                * page_ + write_offset[:, None])               # (B, KV)
+
+    def write(pool, new):
+        flat = pool.reshape(L_, N_ * KV_ * page_, hd_)
+        flat = flat.at[:, flat_idx].set(new.astype(pool.dtype))
+        return flat.reshape(L_, N_, KV_, page_, hd_)
+
+    cache = {"k": write(kv_cache["k"], new_k),
+             "v": write(kv_cache["v"], new_v)}
     return unembed(params, cfg, h), cache
 
 

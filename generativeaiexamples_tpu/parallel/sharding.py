@@ -91,7 +91,7 @@ def kv_cache_spec(cfg: LlamaConfig, mesh: Mesh) -> Specs:
 
 
 def paged_kv_cache_spec(cfg: LlamaConfig, mesh: Mesh) -> Specs:
-    """Paged cache (L, N, page, KV, hd): KV heads over tp, pages replicated.
+    """Paged cache (L, N, KV, page, hd): KV heads over tp, pages replicated.
 
     The page pool has no batch axis (slots share it through block tables),
     so dp does not appear; layers shard over pp like the params.
@@ -99,7 +99,7 @@ def paged_kv_cache_spec(cfg: LlamaConfig, mesh: Mesh) -> Specs:
     tp = _axis_on(mesh, "tp")
     pp = _axis_on(mesh, "pp")
     kv_tp = tp if tp and cfg.num_kv_heads % mesh.shape["tp"] == 0 else None
-    spec = P(pp, None, None, kv_tp, None)
+    spec = P(pp, None, kv_tp, None, None)
     return {"k": spec, "v": spec}
 
 
