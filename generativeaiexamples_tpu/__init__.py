@@ -6,12 +6,13 @@ idiomatic JAX/XLA/Pallas/pjit:
 
 - ``models/``    JAX model definitions (Llama-2/CodeLlama, BERT-style e5
                  embedder, Mixtral MoE) with HF checkpoint importers.
-- ``ops/``       TPU compute primitives: RoPE, RMSNorm, flash/paged attention
-                 (Pallas kernels with jnp fallbacks), sampling, quantized
-                 matmul, on-device top-k retrieval.
+- ``ops/``       TPU compute primitives: RoPE, RMSNorm, attention (incl. a
+                 Pallas paged-attention decode kernel with a jnp fallback),
+                 sampling, quantized matmul, on-device top-k retrieval.
 - ``parallel/``  Device-mesh construction and sharding rules (dp/tp/pp/ep/sp
-                 axes over ICI; DCN for multi-host) — the XLA-collectives
-                 answer to the reference's NCCL/mpirun stack
+                 axes over ICI; ``jax.distributed`` bootstrap for multi-host
+                 DCN) — the XLA-collectives answer to the reference's
+                 NCCL/mpirun stack
                  (reference: llm-inference-server/model_server/server.py:78-101).
 - ``engine/``    The TensorRT-LLM/Triton replacement: continuous-batching
                  scheduler, slotted/paged KV cache, streaming detokenizer,

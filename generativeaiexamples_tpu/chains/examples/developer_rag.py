@@ -16,6 +16,7 @@ import os
 from typing import Generator, Optional
 
 from ...embed.encoder import get_embedder
+from ...obs.tracing import event_span
 from ...retrieval.docstore import Document, DocumentIndex
 from ...utils.app_config import get_config
 from ...utils.logging import get_logger
@@ -71,22 +72,35 @@ class QAChatbot(BaseExample):
                   ) -> Generator[str, None, None]:
         prompt = self.config.prompts.chat_template.format(
             context_str=context or "", query_str=question)
-        yield from self.llm.stream(prompt, max_tokens=num_tokens,
-                                   stop=["</s>", "[INST]"])
+        with event_span("llm", num_tokens=num_tokens):
+            yield from self.llm.stream(prompt, max_tokens=num_tokens,
+                                       stop=["</s>", "[INST]"])
 
     def rag_chain(self, prompt: str, num_tokens: int,
                   ) -> Generator[str, None, None]:
-        docs = self.index.similarity_search(prompt,
-                                            k=self.config.retriever.top_k)
-        context_texts = cap_context(
-            [d.text for d in docs],
-            max_tokens=self.config.retriever.max_context_tokens,
-            tokenizer=self.splitter.tok)
-        context = "\n\n".join(context_texts)
-        full_prompt = self.config.prompts.rag_template.format(
-            context_str=context, query_str=prompt)
-        yield from self.llm.stream(full_prompt, max_tokens=num_tokens,
-                                   stop=["</s>", "[INST]"])
+        # Child spans per pipeline stage — the retrieve/synthesize/llm
+        # events the reference bridges out of LlamaIndex callbacks
+        # (reference: tools/observability/llamaindex/
+        # opentelemetry_callback.py:84-197).
+        with event_span("retrieve", top_k=self.config.retriever.top_k) as sp:
+            docs = self.index.similarity_search(
+                prompt, k=self.config.retriever.top_k)
+            if sp is not None:
+                for i, d in enumerate(docs):
+                    sp.set_attribute(f"retrieval.score.{i}",
+                                     float(d.score or 0.0))
+        with event_span("templating", n_docs=len(docs)):
+            context_texts = cap_context(
+                [d.text for d in docs],
+                max_tokens=self.config.retriever.max_context_tokens,
+                tokenizer=self.splitter.tok)
+            context = "\n\n".join(context_texts)
+            full_prompt = self.config.prompts.rag_template.format(
+                context_str=context, query_str=prompt)
+        with event_span("llm", num_tokens=num_tokens,
+                        prompt_chars=len(full_prompt)):
+            yield from self.llm.stream(full_prompt, max_tokens=num_tokens,
+                                       stop=["</s>", "[INST]"])
 
     # ------------------------------------------------------------- search
 

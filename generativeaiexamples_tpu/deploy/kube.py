@@ -27,6 +27,13 @@ def key_str(key: ObjKey) -> str:
     return "/".join(key)
 
 
+def parse_key(s: str) -> ObjKey:
+    """Inverse of key_str. apiVersion itself may contain '/' (apps/v1), so
+    split from the right: the last three components are kind/ns/name."""
+    api, kind, ns, name = s.rsplit("/", 3)
+    return (api, kind, ns, name)
+
+
 class KubeInterface(abc.ABC):
     """What the reconciler needs from a cluster."""
 

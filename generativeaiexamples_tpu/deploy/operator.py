@@ -28,7 +28,7 @@ from urllib.parse import urlparse
 
 from .helm import ChartError, load_chart, render_chart
 from .kube import (KubeInterface, drain_order, ensure_labels, key_str,
-                   obj_key)
+                   obj_key, parse_key)
 from .types import OWNED_BY_LABEL, HelmPipeline, ReleaseState
 
 logger = logging.getLogger("tpu-rag.operator")
@@ -118,8 +118,8 @@ class PipelineOperator:
                     self.kube.apply(obj)
                     keys.append(key_str(obj_key(obj)))
                 if prev:  # prune objects dropped by the new rendering
-                    for stale in set(prev.object_keys) - set(keys):
-                        self.kube.delete(tuple(stale.split("/")))  # type: ignore[arg-type]
+                    for stale in sorted(set(prev.object_keys) - set(keys)):
+                        self.kube.delete(parse_key(stale))
                 state[pkg.release] = ReleaseState(
                     release=pkg.release, chart=chart.name,
                     version=chart.version, manifest_hash=manifest_hash,

@@ -153,6 +153,7 @@ class _Request:
     proj_pos: int = 0         # host upper bound on the device-side pos
     generated: int = 0
     greedy: bool = False      # top_k==1 / temp<=0: argmax fast path
+    banned_ids: list[int] = field(default_factory=list)
 
     @property
     def done(self) -> bool:
@@ -215,6 +216,7 @@ class Engine:
             "top_p": jnp.zeros((B,), jnp.float32),
             "rep_pen": jnp.ones((B,), jnp.float32),
             "seen": jnp.zeros((B, model_cfg.vocab_size), bool),
+            "banned": jnp.zeros((B, model_cfg.vocab_size), bool),
         }
         if mesh is not None:
             cache_specs = paged_kv_cache_spec(model_cfg, mesh)
@@ -409,12 +411,13 @@ class Engine:
         B = cfg.max_slots
         L = mcfg.num_layers
 
-        def prefill(params, tokens, length, temp, top_k, top_p, rep_pen, key,
-                    greedy: bool):
+        def prefill(params, tokens, length, temp, top_k, top_p, rep_pen,
+                    banned, key, greedy: bool):
             """tokens: (1, S_bucket); returns (k,v) for the bucket, the
             sampled first token, and the prompt's seen-token mask.
-            ``greedy`` is a trace-time flag: the greedy variant is a pure
-            argmax — no vocab sort on the TTFT-critical path."""
+            ``banned``: (V,) bool bad-words token mask. ``greedy`` is a
+            trace-time flag: the greedy variant is a pure argmax — no
+            vocab sort on the TTFT-critical path."""
             S = tokens.shape[1]
             positions = jnp.arange(S, dtype=jnp.int32)[None, :]
             cache = llama.init_kv_cache(mcfg, 1, S, self._dtype)
@@ -426,6 +429,7 @@ class Engine:
             seen = seen_mask(tokens, length[None], mcfg.vocab_size)  # (1, V)
             last = apply_repetition_penalty(last[None, :], seen,
                                             rep_pen[None])
+            last = jnp.where(banned[None, :], -1e30, last)
             if greedy:
                 first_tok = jnp.argmax(last[0].astype(jnp.float32)
                                        ).astype(jnp.int32)
@@ -436,7 +440,8 @@ class Engine:
             return cache["k"], cache["v"], first_tok, seen
 
         def insert(state, k_new, v_new, slot, length, first_tok,
-                   temp, top_k, top_p, rep_pen, seen, row, remaining, eos_ok):
+                   temp, top_k, top_p, rep_pen, seen, banned, row,
+                   remaining, eos_ok):
             """Scatter a prefilled bucket into the slot's pages and arm the
             slot. ``row``: (Pmax,) physical page per logical page, padded
             with 0 (trash) — bucket overhang beyond the allocated extent
@@ -471,6 +476,7 @@ class Engine:
                 "top_p": state["top_p"].at[slot].set(top_p),
                 "rep_pen": state["rep_pen"].at[slot].set(rep_pen),
                 "seen": state["seen"].at[slot].set(seen),
+                "banned": state["banned"].at[slot].set(banned),
             }
 
         def make_round(window: int, steps: int, greedy: bool):
@@ -494,6 +500,7 @@ class Engine:
                         use_kernel=self._use_kernel)
                     penalized = apply_repetition_penalty(
                         logits[:, 0], st["seen"], st["rep_pen"])
+                    penalized = jnp.where(st["banned"], -1e30, penalized)
                     if greedy:
                         tok = jnp.argmax(penalized.astype(jnp.float32),
                                          axis=-1).astype(jnp.int32)
@@ -523,7 +530,7 @@ class Engine:
         def release(state, slot):
             return dict(state, active=state["active"].at[slot].set(False))
 
-        self._prefill_jit = jax.jit(prefill, static_argnums=(8,))
+        self._prefill_jit = jax.jit(prefill, static_argnums=(9,))
         self._insert = jax.jit(insert, donate_argnums=(0,))
         self._release = jax.jit(release, donate_argnums=(0,))
         self._make_round = make_round
@@ -631,13 +638,31 @@ class Engine:
             raise EngineError(
                 f"request needs {need} KV pages but the pool only has "
                 f"{self._n_pages - 1} (kv_pool_tokens too small)")
+        banned_ids: list[int] = []
+        for word in params.bad_words:
+            # Subword tokenizers give a word two single-token spellings —
+            # word-initial (metaspace-prefixed) and continuation — ban
+            # every single-token variant so neither slips the mask.
+            variants = []
+            for text in (word, " " + word):
+                ids = self.tokenizer.encode(text, add_bos=False)
+                if len(ids) == 1:
+                    variants.append(int(ids[0]))
+            if not variants:
+                n = len(self.tokenizer.encode(word, add_bos=False))
+                raise EngineError(
+                    f"bad_words entry {word!r} tokenizes to {n} tokens; "
+                    "only single-token bans are supported (device-side "
+                    "sequence banning is not implemented)")
+            banned_ids.extend(variants)
         stream = TokenStream(next(self._req_counter))
         req = _Request(stream=stream, prompt_ids=list(prompt_ids),
                        params=params, eff_max=eff_max,
                        extent=len(prompt_ids) + eff_max,
                        detok=IncrementalDetokenizer(self.tokenizer),
                        stop=StopChecker(params.stop_words),
-                       greedy=(params.top_k == 1 or params.temperature <= 0))
+                       greedy=(params.top_k == 1 or params.temperature <= 0),
+                       banned_ids=banned_ids)
         try:
             self._pending.put_nowait((req, params))
         except queue.Full:
@@ -741,18 +766,22 @@ class Engine:
             ids = req.prompt_ids + [0] * (bucket - len(req.prompt_ids))
             tokens = jnp.asarray(np.asarray(ids, np.int32)[None, :])
             length = jnp.int32(len(req.prompt_ids))
+            banned_row = np.zeros((self.model_cfg.vocab_size,), bool)
+            if req.banned_ids:
+                banned_row[req.banned_ids] = True
+            banned = jnp.asarray(banned_row)
             key = jax.random.fold_in(self._base_key,
                                      next(self._step_counter) ^ sp.random_seed)
             k_new, v_new, first_tok, seen = self._prefill(
                 self.params, tokens, length,
                 jnp.float32(sp.temperature), jnp.int32(sp.top_k),
                 jnp.float32(sp.top_p), jnp.float32(sp.repetition_penalty),
-                key, greedy=req.greedy)
+                banned, key, greedy=req.greedy)
             self._state = self._insert(
                 self._state, k_new, v_new, jnp.int32(slot), length, first_tok,
                 jnp.float32(sp.temperature), jnp.int32(sp.top_k),
                 jnp.float32(sp.top_p), jnp.float32(sp.repetition_penalty),
-                seen, jnp.asarray(row), jnp.int32(req.eff_max - 1),
+                seen, banned, jnp.asarray(row), jnp.int32(req.eff_max - 1),
                 jnp.bool_(not sp.ignore_eos))
             self._bump("prefills")
             self._slots[slot] = req

@@ -18,10 +18,19 @@ class SamplingParams:
     top_k: int = 1
     top_p: float = 0.0
     repetition_penalty: float = 1.0
-    length_penalty: float = 1.0       # accepted for API parity (beam=1 ⇒ no-op)
+    # length_penalty reweights beam-search hypotheses; with beam_width
+    # fixed to 1 (TRT default) only the neutral 1.0 is honest to accept —
+    # anything else errors instead of silently no-opping.
+    length_penalty: float = 1.0
     beam_width: int = 1               # only 1 supported, like TRT default
     random_seed: int = 0
     stop_words: list[str] = field(default_factory=list)
+    # Words banned from being generated (reference: ensemble bad_words
+    # tensor + to_word_list_format, preprocessing/1/model.py:211). Banned
+    # device-side via a logits mask; each entry must tokenize to a single
+    # token — multi-token sequence banning needs device-side sequence
+    # matching and is rejected loudly rather than approximated.
+    bad_words: list[str] = field(default_factory=list)
     ignore_eos: bool = False          # benchmarking aid
 
     def __post_init__(self) -> None:
@@ -29,3 +38,7 @@ class SamplingParams:
             raise ValueError("beam_width != 1 is not supported")
         if self.max_tokens < 1:
             raise ValueError("max_tokens must be >= 1")
+        if self.length_penalty != 1.0:
+            raise ValueError(
+                "length_penalty requires beam search (beam_width > 1), "
+                "which is not supported; use 1.0")

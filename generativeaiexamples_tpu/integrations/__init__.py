@@ -1,0 +1,19 @@
+"""Published connector classes for third-party orchestration frameworks.
+
+The reference ships LangChain connector classes as its public integration
+surface (reference: integrations/langchain/llms/triton_trt_llm.py:48
+``TensorRTLLM(LLM)``, nemo_infer.py, embeddings/nemo_embed.py). The TPU
+stack's equivalents:
+
+- ``langchain_tpu``  — ``TpuLLM`` (LangChain ``LLM``) and
+  ``TpuEmbeddings`` (LangChain ``Embeddings``) over the serving stack's
+  gRPC or OpenAI-compatible HTTP endpoints.
+- ``llamaindex_tpu`` — ``TpuLlamaIndexLLM`` (LlamaIndex ``CustomLLM``)
+  and ``TpuLlamaIndexEmbedding`` over the same endpoints.
+
+Both modules import-degrade: when langchain/llama_index are not
+installed, the classes derive from small structural stand-ins with the
+same method contracts, so the connector logic stays importable and
+testable anywhere (the reference's connectors hard-require their
+frameworks).
+"""

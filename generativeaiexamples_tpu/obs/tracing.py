@@ -32,25 +32,30 @@ def _get_tracer():
     global _tracer
     if _tracer is None and _ENABLED:
         from opentelemetry import trace
-        from opentelemetry.sdk.resources import Resource
-        from opentelemetry.sdk.trace import TracerProvider
-        from opentelemetry.sdk.trace.export import (BatchSpanProcessor,
-                                                    ConsoleSpanExporter)
+        try:
+            from opentelemetry.sdk.resources import Resource
+            from opentelemetry.sdk.trace import TracerProvider
+            from opentelemetry.sdk.trace.export import (BatchSpanProcessor,
+                                                        ConsoleSpanExporter)
 
-        service = os.environ.get("OTEL_SERVICE_NAME", "chain-server")
-        provider = TracerProvider(
-            resource=Resource.create({"service.name": service}))
-        endpoint = os.environ.get("OTEL_EXPORTER_OTLP_ENDPOINT")
-        if endpoint:
-            try:
-                from opentelemetry.exporter.otlp.proto.grpc.trace_exporter \
-                    import OTLPSpanExporter
-                provider.add_span_processor(
-                    BatchSpanProcessor(OTLPSpanExporter(endpoint=endpoint)))
-            except ImportError:
-                provider.add_span_processor(
-                    BatchSpanProcessor(ConsoleSpanExporter()))
-        trace.set_tracer_provider(provider)
+            service = os.environ.get("OTEL_SERVICE_NAME", "chain-server")
+            provider = TracerProvider(
+                resource=Resource.create({"service.name": service}))
+            endpoint = os.environ.get("OTEL_EXPORTER_OTLP_ENDPOINT")
+            if endpoint:
+                try:
+                    from opentelemetry.exporter.otlp.proto.grpc \
+                        .trace_exporter import OTLPSpanExporter
+                    provider.add_span_processor(BatchSpanProcessor(
+                        OTLPSpanExporter(endpoint=endpoint)))
+                except ImportError:
+                    provider.add_span_processor(
+                        BatchSpanProcessor(ConsoleSpanExporter()))
+            trace.set_tracer_provider(provider)
+        except ImportError:
+            # api-only install: the global provider yields non-recording
+            # spans — tracing stays wired but exports nothing.
+            pass
         _tracer = trace.get_tracer("generativeaiexamples_tpu")
     return _tracer
 

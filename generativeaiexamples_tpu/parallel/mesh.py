@@ -9,6 +9,7 @@ model_server/model.py:111-138) with a ``jax.sharding.Mesh``. Axis order puts
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from typing import Optional, Sequence
 
@@ -20,6 +21,43 @@ from ..utils.errors import ShardingError
 
 # Canonical mesh axes: data, pipeline, expert, sequence, tensor.
 AXES = ("dp", "pp", "ep", "sp", "tp")
+
+_distributed_initialized = False
+
+
+def maybe_init_distributed(coordinator: str = "", num_processes: int = 0,
+                           process_id: int = -1) -> bool:
+    """Multi-host DCN bootstrap: ``jax.distributed.initialize``.
+
+    The multi-controller replacement for the reference's mpirun launcher
+    (reference: model_server/server.py:78-101 — one Triton process per
+    rank): every host runs the same program; JAX wires the hosts over DCN
+    and ``jax.devices()`` becomes the global device list, so the same mesh
+    code spans hosts. Args fall back to the standard env vars
+    (GAIE_COORDINATOR / GAIE_NUM_PROCESSES / GAIE_PROCESS_ID, or JAX's own
+    auto-detection on Cloud TPU pods). Returns True if distributed mode
+    was (already) initialized; single-host setups no-op.
+    """
+    global _distributed_initialized
+    if _distributed_initialized:
+        return True
+    coordinator = coordinator or os.environ.get("GAIE_COORDINATOR", "")
+    num_processes = num_processes or int(
+        os.environ.get("GAIE_NUM_PROCESSES", "0"))
+    process_id = process_id if process_id >= 0 else int(
+        os.environ.get("GAIE_PROCESS_ID", "-1"))
+    if not coordinator and num_processes <= 1:
+        return False
+    kwargs = {}
+    if coordinator:
+        kwargs["coordinator_address"] = coordinator
+    if num_processes:
+        kwargs["num_processes"] = num_processes
+    if process_id >= 0:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+    _distributed_initialized = True
+    return True
 
 
 @dataclass(frozen=True)

@@ -56,9 +56,11 @@ class DocumentIndex:
 
     def similarity_search(self, query: str, k: int = 4) -> list[Document]:
         """Top-k documents for a text query (embedder's query mode)."""
+        from ..obs.tracing import event_span
         if len(self.store) == 0:
             return []
-        q = np.asarray(self.embedder.embed_query(query), np.float32)
+        with event_span("embedding", mode="query", chars=len(query)):
+            q = np.asarray(self.embedder.embed_query(query), np.float32)
         hits = self.store.search(q, k=k)[0]
         out = []
         for hit in hits:

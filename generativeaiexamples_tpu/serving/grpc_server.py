@@ -1,0 +1,220 @@
+"""gRPC serving endpoint (tpu.serving.LLMService).
+
+The reference serves gRPC on :8001 via Triton and its connectors default
+to it (reference: model_server_client/trt_llm.py:370 ``GrpcTritonClient``,
+server URL ``localhost:8001``). Here the gRPC surface is first-party:
+unary + server-streaming Generate with the ensemble tensor semantics
+(decoupled deltas, final-response flag, stop signal via RPC cancellation)
+and an Embed RPC for the encoder.
+
+Service stubs are registered with ``grpc.method_handlers_generic_handler``
+— the image ships protoc without the grpcio-tools plugin, so messages are
+protoc-generated (serving/protos) and handlers are wired by hand; the
+wire format is identical to what generated stubs would produce.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent import futures
+from typing import Iterator, Optional
+
+import grpc
+
+from ..obs import metrics as obs_metrics
+from ..utils.errors import EngineError
+from ..utils.logging import get_logger
+from .protos import llm_service_pb2 as pb
+
+logger = get_logger(__name__)
+
+SERVICE = "tpu.serving.LLMService"
+
+
+def _params_from_request(req, max_output: int):
+    from ..engine.sampling_params import SamplingParams
+    if req.beam_width not in (0, 1):
+        raise ValueError("beam_width != 1 is not supported")
+    return SamplingParams(
+        max_tokens=min(req.max_tokens or 100, max_output),
+        temperature=req.temperature if req.temperature else 1.0,
+        top_k=req.top_k if req.top_k else 1,
+        top_p=req.top_p,
+        repetition_penalty=(req.repetition_penalty
+                            if req.repetition_penalty else 1.0),
+        length_penalty=req.length_penalty if req.length_penalty else 1.0,
+        random_seed=req.random_seed,
+        stop_words=list(req.stop_words),
+        bad_words=list(req.bad_words),
+        ignore_eos=req.ignore_eos,
+    )
+
+
+class LLMServicer:
+    """Handler implementations (the servicer generated stubs would wrap)."""
+
+    def __init__(self, engine, model_name: str = "model",
+                 embed_service=None, max_output: int = 512):
+        self.engine = engine
+        self.model_name = model_name
+        self.embed_service = embed_service
+        self.max_output = max_output
+
+    def Health(self, request, context) -> pb.HealthResponse:
+        return pb.HealthResponse(ready=True, model_name=self.model_name)
+
+    def _submit(self, request, context):
+        self.engine.start()
+        try:
+            params = _params_from_request(request, self.max_output)
+            return self.engine.stream_text(request.text_input, params)
+        except (ValueError, EngineError) as exc:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
+
+    def Generate(self, request, context) -> pb.GenerateResponse:
+        timer = obs_metrics.RequestTimer("grpc_generate")
+        stream = self._submit(request, context)
+        try:
+            chunks = []
+            for chunk in stream:
+                timer.token(1)
+                chunks.append(chunk)
+            return pb.GenerateResponse(
+                model_name=self.model_name, text_output="".join(chunks),
+                final=True, finish_reason=stream.finish_reason or "")
+        finally:
+            timer.finish()
+
+    def GenerateStream(self, request, context
+                       ) -> Iterator[pb.GenerateResponse]:
+        """Decoupled-mode deltas + a final-response marker; client-side RPC
+        cancellation doubles as the mid-stream stop signal
+        (reference: trt_llm.py:392-400 ``_send_stop_signals``)."""
+        timer = obs_metrics.RequestTimer("grpc_generate")
+        stream = self._submit(request, context)
+        context.add_callback(stream.cancel)   # client hung up -> free slot
+        try:
+            for chunk in stream:
+                timer.token(1)
+                yield pb.GenerateResponse(model_name=self.model_name,
+                                          text_output=chunk, final=False)
+            yield pb.GenerateResponse(
+                model_name=self.model_name, text_output="", final=True,
+                finish_reason=stream.finish_reason or "")
+        finally:
+            timer.finish()
+
+    def Embed(self, request, context) -> pb.EmbedResponse:
+        if self.embed_service is None:
+            context.abort(grpc.StatusCode.UNIMPLEMENTED,
+                          "no embedder configured")
+        texts = list(request.texts)
+        if not texts:
+            return pb.EmbedResponse(dim=self.embed_service.dim)
+        if request.input_type == "query":
+            rows = [self.embed_service.embed_query(t) for t in texts]
+        else:
+            rows = list(self.embed_service.embed_documents(texts))
+        flat = [float(x) for row in rows for x in row]
+        return pb.EmbedResponse(dim=len(flat) // len(texts), values=flat)
+
+
+def _handlers(servicer: LLMServicer):
+    rpcs = {
+        "Health": grpc.unary_unary_rpc_method_handler(
+            servicer.Health,
+            request_deserializer=pb.HealthRequest.FromString,
+            response_serializer=pb.HealthResponse.SerializeToString),
+        "Generate": grpc.unary_unary_rpc_method_handler(
+            servicer.Generate,
+            request_deserializer=pb.GenerateRequest.FromString,
+            response_serializer=pb.GenerateResponse.SerializeToString),
+        "GenerateStream": grpc.unary_stream_rpc_method_handler(
+            servicer.GenerateStream,
+            request_deserializer=pb.GenerateRequest.FromString,
+            response_serializer=pb.GenerateResponse.SerializeToString),
+        "Embed": grpc.unary_unary_rpc_method_handler(
+            servicer.Embed,
+            request_deserializer=pb.EmbedRequest.FromString,
+            response_serializer=pb.EmbedResponse.SerializeToString),
+    }
+    return grpc.method_handlers_generic_handler(SERVICE, rpcs)
+
+
+def serve_grpc(engine, model_name: str = "model", embed_service=None,
+               max_output: int = 512, host: str = "0.0.0.0",
+               port: int = 8001, max_workers: int = 16) -> grpc.Server:
+    """Start the gRPC server (non-blocking); returns the grpc.Server."""
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers,
+                                   thread_name_prefix="grpc"))
+    server.add_generic_rpc_handlers((_handlers(LLMServicer(
+        engine, model_name, embed_service, max_output)),))
+    bound = server.add_insecure_port(f"{host}:{port}")
+    if bound == 0:  # grpc reports bind failure via a 0 port, not an error
+        raise OSError(f"gRPC failed to bind {host}:{port} (port in use?)")
+    server.start()
+    logger.info("gRPC serving %s on %s:%d", model_name, host, bound)
+    server._bound_port = bound  # convenience for tests/port-0 binds
+    return server
+
+
+class GrpcLLMClient:
+    """Minimal client over the same hand-wired stubs (streaming generate,
+    embed, readiness polling — the roles of the reference's
+    GrpcTritonClient, trt_llm.py:370-499)."""
+
+    def __init__(self, target: str, timeout: float = 120.0):
+        self.channel = grpc.insecure_channel(target)
+        self.timeout = timeout
+        self._generate = self.channel.unary_unary(
+            f"/{SERVICE}/Generate",
+            request_serializer=pb.GenerateRequest.SerializeToString,
+            response_deserializer=pb.GenerateResponse.FromString)
+        self._generate_stream = self.channel.unary_stream(
+            f"/{SERVICE}/GenerateStream",
+            request_serializer=pb.GenerateRequest.SerializeToString,
+            response_deserializer=pb.GenerateResponse.FromString)
+        self._embed = self.channel.unary_unary(
+            f"/{SERVICE}/Embed",
+            request_serializer=pb.EmbedRequest.SerializeToString,
+            response_deserializer=pb.EmbedResponse.FromString)
+        self._health = self.channel.unary_unary(
+            f"/{SERVICE}/Health",
+            request_serializer=pb.HealthRequest.SerializeToString,
+            response_deserializer=pb.HealthResponse.FromString)
+
+    def wait_ready(self, timeout: float = 30.0) -> pb.HealthResponse:
+        import time
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                return self._health(pb.HealthRequest(), timeout=2.0)
+            except grpc.RpcError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.2)
+
+    def generate(self, text: str, **kw) -> str:
+        resp = self._generate(pb.GenerateRequest(text_input=text, **kw),
+                              timeout=self.timeout)
+        return resp.text_output
+
+    def generate_stream(self, text: str, **kw) -> Iterator[str]:
+        for resp in self._generate_stream(
+                pb.GenerateRequest(text_input=text, **kw),
+                timeout=self.timeout):
+            if resp.final:
+                return
+            yield resp.text_output
+
+    def embed(self, texts: list[str], input_type: str = "passage"):
+        resp = self._embed(pb.EmbedRequest(texts=texts,
+                                           input_type=input_type),
+                           timeout=self.timeout)
+        import numpy as np
+        return np.asarray(resp.values, np.float32).reshape(
+            len(texts), resp.dim)
+
+    def close(self) -> None:
+        self.channel.close()

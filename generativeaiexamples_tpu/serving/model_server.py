@@ -145,7 +145,17 @@ def build_services(model_type: str = "dev", model_name: str = "",
     world, tp, pp = resolve_topology(world_size, tp, pp)
     mesh = make_mesh(MeshPlan(tp=tp, pp=pp), jax.devices()[:world]) \
         if world > 1 else None
-    setup_compile_cache(f"{model_name}-{dtype}-{quantization or 'raw'}", world)
+    identity = f"{model_name}-{dtype}-{quantization or 'raw'}"
+    if model_path and not os.environ.get("GAIE_SKIP_HASH"):
+        # Weight-content hash in the cache identity — the rebuild gate the
+        # reference applies to its engine cache (model.py:230-241). XLA
+        # programs don't embed weights, so stale reuse is only a naming
+        # hazard, but a renamed/edited checkpoint must not masquerade as
+        # the old one. GAIE_SKIP_HASH=1 skips the startup hash cost.
+        digest = fast_hash_dir(model_path)[:12]
+        logger.info("checkpoint hash %s", digest)
+        identity += f"-{digest}"
+    setup_compile_cache(identity, world)
 
     if model_type == "dev":
         # Random-init tiny model: air-gapped dev/e2e mode (the 'fake
@@ -230,7 +240,22 @@ def main(argv: Optional[list[str]] = None) -> None:
     parser.add_argument("--no-embedder", action="store_true")
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=8000)
+    parser.add_argument("--grpc-port", type=int, default=8001,
+                        help="gRPC LLMService port (0 disables); the "
+                             "reference's Triton serves gRPC on 8001")
+    # Multi-host DCN (reference launches one Triton per rank under mpirun,
+    # server.py:78-101; here every host runs this same CLI and JAX wires
+    # them over DCN).
+    parser.add_argument("--coordinator", default="",
+                        help="host:port of process 0 for multi-host DCN")
+    parser.add_argument("--num-processes", type=int, default=0)
+    parser.add_argument("--process-id", type=int, default=-1)
     args = parser.parse_args(argv)
+
+    from ..parallel.mesh import maybe_init_distributed
+    if maybe_init_distributed(args.coordinator, args.num_processes,
+                              args.process_id):
+        logger.info("jax.distributed initialized (multi-host DCN)")
 
     engine, embed_service, model_name = build_services(
         model_type=args.model_type, model_name=args.model_name,
@@ -242,9 +267,19 @@ def main(argv: Optional[list[str]] = None) -> None:
         max_slots=args.max_batch_size, dtype=args.dtype,
         with_embedder=not args.no_embedder)
     engine.start()
+    grpc_server = None  # keep the reference: grpc.Server stops when GC'd
+    if args.grpc_port:
+        from .grpc_server import serve_grpc
+        grpc_server = serve_grpc(engine, model_name, embed_service,
+                                 max_output=engine.cfg.max_output_length,
+                                 host=args.host, port=args.grpc_port)
     logger.info("serving %s on %s:%d", model_name, args.host, args.port)
-    web.run_app(create_server_app(engine, embed_service, model_name),
-                host=args.host, port=args.port)
+    try:
+        web.run_app(create_server_app(engine, embed_service, model_name),
+                    host=args.host, port=args.port)
+    finally:
+        if grpc_server is not None:
+            grpc_server.stop(grace=1.0)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,9 @@
+"""Generated protobuf messages for the gRPC serving surface.
+
+``llm_service_pb2.py`` is generated from ``llm_service.proto`` with plain
+``protoc --python_out`` (the image has protoc but not grpcio-tools, so
+service stubs are built with grpc generic handlers instead — see
+serving/grpc_server.py).
+"""
+
+from . import llm_service_pb2  # noqa: F401

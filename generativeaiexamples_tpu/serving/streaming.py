@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 import asyncio
+import contextvars
 from typing import AsyncIterator, Iterator
 
 _SENTINEL = object()
@@ -52,7 +53,11 @@ async def iterate_in_thread(it: Iterator[str],
                     pass
             _put(_SENTINEL)
 
-    producer = loop.run_in_executor(None, produce)
+    # Run the producer under the caller's contextvars: executor threads
+    # don't inherit them, which would orphan the chain's OTel child spans
+    # (retrieve/embed/llm) from the request's server span.
+    ctx = contextvars.copy_context()
+    producer = loop.run_in_executor(None, lambda: ctx.run(produce))
     try:
         while True:
             item = await q.get()

@@ -24,6 +24,7 @@ from aiohttp import web
 
 from ..engine.sampling_params import SamplingParams
 from ..obs import metrics as obs_metrics
+from ..utils.errors import EngineError
 from ..obs.tracing import instrumented
 from .streaming import iterate_in_thread
 
@@ -40,22 +41,29 @@ def _params_from_triton(body: dict, max_output: int) -> SamplingParams:
         v = body.get(name)
         return cast(_first(v)) if v is not None else default
 
-    stop_words = body.get("stop_words") or []
-    if isinstance(stop_words, str):
-        stop_words = [stop_words]
-    stop_words = [str(s) for s in stop_words if s]
+    def words(name: str) -> list[str]:
+        v = body.get(name) or []
+        if isinstance(v, str):
+            v = [v]
+        return [str(s) for s in v if s]
+
     beam = get("beam_width", 1, int)
     if beam != 1:
         raise web.HTTPBadRequest(text="beam_width != 1 is not supported")
-    return SamplingParams(
-        max_tokens=min(get("max_tokens", 100, int), max_output),
-        temperature=get("temperature", 1.0, float),
-        top_k=get("top_k", 1, int),
-        top_p=get("top_p", 0.0, float),
-        repetition_penalty=get("repetition_penalty", 1.0, float),
-        random_seed=get("random_seed", 0, int),
-        stop_words=stop_words,
-    )
+    try:
+        return SamplingParams(
+            max_tokens=min(get("max_tokens", 100, int), max_output),
+            temperature=get("temperature", 1.0, float),
+            top_k=get("top_k", 1, int),
+            top_p=get("top_p", 0.0, float),
+            repetition_penalty=get("repetition_penalty", 1.0, float),
+            length_penalty=get("length_penalty", 1.0, float),
+            random_seed=get("random_seed", 0, int),
+            stop_words=words("stop_words"),
+            bad_words=words("bad_words"),
+        )
+    except ValueError as exc:  # e.g. length_penalty without beam search
+        raise web.HTTPBadRequest(text=str(exc)) from exc
 
 
 def add_triton_routes(app: web.Application, engine, model_name: str = "ensemble",
@@ -95,7 +103,10 @@ def add_triton_routes(app: web.Application, engine, model_name: str = "ensemble"
                 text=f"invalid parameters: {exc}") from exc
         timer = obs_metrics.RequestTimer("triton_generate")
         engine.start()
-        stream = engine.stream_text(text_input, params)
+        try:
+            stream = engine.stream_text(text_input, params)
+        except EngineError as exc:  # invalid request (length, bad_words...)
+            raise web.HTTPBadRequest(text=str(exc)) from exc
         chunks = []
         async for chunk in iterate_in_thread(iter(stream)):
             timer.token(1)  # one chunk ≈ one decode step
@@ -118,7 +129,10 @@ def add_triton_routes(app: web.Application, engine, model_name: str = "ensemble"
                 text=f"invalid parameters: {exc}") from exc
         timer = obs_metrics.RequestTimer("triton_generate")
         engine.start()
-        stream = engine.stream_text(text_input, params)
+        try:
+            stream = engine.stream_text(text_input, params)
+        except EngineError as exc:  # invalid request (length, bad_words...)
+            raise web.HTTPBadRequest(text=str(exc)) from exc
 
         resp = web.StreamResponse(
             headers={"Content-Type": "text/event-stream",

@@ -1,0 +1,121 @@
+"""Connector-class tests against a served fixture (reference behavior:
+integrations/langchain/llms/triton_trt_llm.py — LLM subclass streaming
+through the serving endpoint; embeddings with passage/query modes)."""
+
+import asyncio
+import threading
+
+import jax
+import jax.numpy as jnp
+import pytest
+from aiohttp import web
+
+from generativeaiexamples_tpu.engine import Engine, EngineConfig
+from generativeaiexamples_tpu.integrations.langchain_tpu import (
+    STOP_WORDS, TpuEmbeddings, TpuLLM)
+from generativeaiexamples_tpu.integrations.llamaindex_tpu import (
+    TpuLlamaIndexEmbedding, TpuLlamaIndexLLM)
+from generativeaiexamples_tpu.models import llama
+from generativeaiexamples_tpu.models.configs import LLAMA_TINY
+from generativeaiexamples_tpu.models.tokenizer import ByteTokenizer
+from generativeaiexamples_tpu.serving.grpc_server import serve_grpc
+from generativeaiexamples_tpu.serving.model_server import create_server_app
+
+
+@pytest.fixture(scope="module")
+def served():
+    """One engine behind both transports: gRPC + the OpenAI/triton HTTP
+    app."""
+    params = llama.init_params(LLAMA_TINY, jax.random.key(0),
+                               dtype=jnp.float32)
+    cfg = EngineConfig(max_slots=2, max_input_length=256,
+                       max_output_length=64, prefill_buckets=(32, 64, 256),
+                       dtype="float32", page_size=16, kv_pool_tokens=None,
+                       steps_per_round=4, dispatch_depth=1)
+    engine = Engine(params, LLAMA_TINY, ByteTokenizer(), cfg)
+    from generativeaiexamples_tpu.embed.encoder import get_embedder
+    embedder = get_embedder("hash", "hash", dim=32)
+
+    grpc_server = serve_grpc(engine, "llama-tiny", embedder, max_output=64,
+                             host="127.0.0.1", port=0)
+
+    app = create_server_app(engine, embedder, "llama-tiny")
+    loop = asyncio.new_event_loop()
+    holder = {}
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+
+        async def boot():
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            holder["port"] = site._server.sockets[0].getsockname()[1]
+        loop.run_until_complete(boot())
+        started.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    started.wait(timeout=30)
+    yield {"grpc": f"127.0.0.1:{grpc_server._bound_port}",
+           "http": f"http://127.0.0.1:{holder['port']}"}
+    loop.call_soon_threadsafe(loop.stop)
+    grpc_server.stop(grace=None)
+    engine.stop()
+
+
+@pytest.mark.parametrize("mode", ["grpc", "http"])
+def test_tpu_llm_call_and_stream(served, mode):
+    llm = TpuLLM(server_url=served[mode], mode=mode, tokens=8)
+    full = llm._call("integration prompt", stop=[])
+    assert isinstance(full, str) and full
+    chunks = [c.text for c in llm._stream("integration prompt", stop=[])]
+    assert "".join(chunks) == full
+
+
+def test_tpu_llm_invoke_contract(served):
+    llm = TpuLLM(server_url=served["grpc"], mode="grpc", tokens=8)
+    assert llm.invoke("contract check", stop=[]) == \
+        llm._call("contract check", stop=[])
+    assert llm._llm_type == "tpu_llm"
+    assert llm._identifying_params["model_name"] == "ensemble"
+
+
+def test_tpu_llm_default_stop_words(served):
+    """No explicit stop -> the reference's </s> default applies."""
+    llm = TpuLLM(server_url=served["grpc"], mode="grpc", tokens=8)
+    assert STOP_WORDS == ["</s>"]
+    assert isinstance(llm._call("stops"), str)
+
+
+@pytest.mark.parametrize("mode", ["grpc", "http"])
+def test_tpu_embeddings(served, mode):
+    emb = TpuEmbeddings(server_url=served[mode], mode=mode)
+    docs = emb.embed_documents(["alpha doc", "beta doc"])
+    assert len(docs) == 2 and len(docs[0]) == 32
+    q = emb.embed_query("alpha doc")
+    assert len(q) == 32
+    # ranking sanity: the query is closest to its own doc
+    import numpy as np
+    sims = [float(np.dot(q, d)) for d in docs]
+    assert sims[0] > sims[1]
+
+
+def test_llamaindex_llm(served):
+    llm = TpuLlamaIndexLLM(server_url=served["grpc"], mode="grpc", tokens=8)
+    resp = llm.complete("llamaindex check")
+    assert resp.text
+    acc = list(llm.stream_complete("llamaindex check"))
+    assert acc[-1].text == resp.text
+    assert llm.metadata.context_window == 3000
+
+
+def test_llamaindex_embedding(served):
+    emb = TpuLlamaIndexEmbedding(server_url=served["grpc"], mode="grpc")
+    v = emb.get_query_embedding("hello")
+    assert len(v) == 32
+    t = emb.get_text_embedding("hello")
+    assert len(t) == 32
