@@ -55,6 +55,11 @@ class EmbeddingService:
     def embed_query(self, text: str) -> np.ndarray:
         return self._embed([f"query: {text}"])[0]
 
+    def embed_queries(self, texts: Sequence[str]) -> np.ndarray:
+        """Batched query-mode embedding (one bucketed dispatch, not one
+        device round-trip per text)."""
+        return self._embed([f"query: {t}" for t in texts])
+
     @property
     def dim(self) -> int:
         return self.cfg.hidden_size
@@ -119,6 +124,9 @@ class HashEmbedder:
 
     def embed_query(self, text: str) -> np.ndarray:
         return self._vec(f"passage: {text}")
+
+    def embed_queries(self, texts: Sequence[str]) -> np.ndarray:
+        return np.stack([self.embed_query(t) for t in texts])
 
 
 def get_embedder(model_engine: str = "tpu-jax",

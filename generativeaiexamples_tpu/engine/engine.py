@@ -640,14 +640,21 @@ class Engine:
                 f"{self._n_pages - 1} (kv_pool_tokens too small)")
         banned_ids: list[int] = []
         for word in params.bad_words:
-            # Subword tokenizers give a word two single-token spellings —
-            # word-initial (metaspace-prefixed) and continuation — ban
-            # every single-token variant so neither slips the mask.
-            variants = []
+            # Subword tokenizers give a word several single-token
+            # spellings — word-initial (metaspace-prefixed, what encode
+            # produces after its dummy prefix) and bare continuation —
+            # ban every variant the vocab holds so none slips the mask.
+            variants = set()
             for text in (word, " " + word):
                 ids = self.tokenizer.encode(text, add_bos=False)
                 if len(ids) == 1:
-                    variants.append(int(ids[0]))
+                    variants.add(int(ids[0]))
+            lookup = getattr(self.tokenizer, "piece_id", None)
+            if lookup is not None:
+                for piece in (word, "▁" + word):
+                    pid = lookup(piece)
+                    if pid is not None:
+                        variants.add(int(pid))
             if not variants:
                 n = len(self.tokenizer.encode(word, add_bos=False))
                 raise EngineError(

@@ -146,6 +146,20 @@ class TpuLLM(_LCLLM):
                 beam_width=p["beam_width"], stop_words=p["stop_words"],
                 bad_words=list(p.get("bad_words", [])))
         else:
+            # The OpenAI-compatible surface carries no penalty/ban
+            # fields; silently differing from mode="grpc" would be worse
+            # than refusing.
+            unsupported = {
+                "repetition_penalty": (p["repetition_penalty"], 1.0),
+                "length_penalty": (p["length_penalty"], 1.0),
+                "beam_width": (p["beam_width"], 1),
+                "bad_words": (list(p.get("bad_words", [])), []),
+            }
+            bad = [k for k, (v, default) in unsupported.items()
+                   if v != default]
+            if bad:
+                raise ValueError(
+                    f"mode='http' does not support {bad}; use mode='grpc'")
             it = self._http().stream(
                 prompt, max_tokens=p["max_tokens"], stop=p["stop_words"],
                 temperature=p["temperature"], top_k=p["top_k"],

@@ -79,6 +79,14 @@ def detect_checkpoint_format(path: str) -> str:
     by extension). We recognize: 'safetensors', 'pytorch_bin', 'meta_pth'.
     """
     names = os.listdir(path)
+    if any(n.endswith(".nemo") for n in names):
+        return "nemo"
+    from .import_quantized import sniff_quantized_format
+    qfmt = sniff_quantized_format(path) \
+        if any(n.endswith((".safetensors", ".pt", ".bin"))
+               for n in names) else ""
+    if qfmt:
+        return qfmt  # 'gptq' | 'awq'
     if any(n.endswith(".safetensors") for n in names):
         return "safetensors"
     if any(re.match(r"pytorch_model.*\.bin$", n) for n in names):
@@ -268,6 +276,12 @@ def load_checkpoint(path: str, cfg: LlamaConfig,
                     dtype: jnp.dtype = jnp.bfloat16) -> Params:
     """Load a checkpoint directory (sniffs format)."""
     fmt = detect_checkpoint_format(path)
+    if fmt in ("gptq", "awq"):
+        from .import_quantized import load_quantized_checkpoint
+        return load_quantized_checkpoint(path, cfg, dtype)
+    if fmt == "nemo":
+        from .import_nemo import load_nemo_checkpoint
+        return load_nemo_checkpoint(path, cfg, dtype)
     iters: dict[str, Callable[[str], Iterator[tuple[str, np.ndarray]]]] = {
         "safetensors": _iter_safetensors,
         "pytorch_bin": _iter_torch_bin,

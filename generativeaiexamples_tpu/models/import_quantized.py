@@ -1,0 +1,246 @@
+"""Pre-quantized checkpoint importers: GPTQ and AWQ.
+
+The reference loads both formats into per-rank TRT engines
+(reference: conversion_scripts/llama/weight.py:979 ``load_from_gptq_llama``
+— int32-packed ``qweight``/``qzeros``/fp16 ``scales`` triples;
+weight.py:1194 ``load_from_awq_llama`` — AMMO-style fp16 weights with
+per-group ``weight_quantizer._amax`` and activation
+``input_quantizer._pre_quant_scale``). Here they land in the group-wise
+int4 leaf format of ops/quant.py:
+
+  GPTQ: w[k,n] = (u[k,n] - 1 - uz[g,n]) * s[g,n]
+        -> {"q4": u-8 packed, "gscale": s, "gbias": (7 - uz) * s}
+  AWQ:  y = (x * pre_s) @ W,  W quantized per-group with scale amax/8
+        -> {"q4", "gscale", "pre_scale"}
+
+GPTQ checkpoints with a non-trivial ``g_idx`` (act-order reordering) are
+rejected loudly — honoring them needs a per-column group gather the
+runtime doesn't implement.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Iterator
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.errors import ModelLoadError
+from .configs import LlamaConfig
+
+Params = dict[str, Any]
+
+# HF projection name -> (our stacked key, weight axes note)
+_PROJ_KEYS = {
+    "self_attn.q_proj": "wq",
+    "self_attn.k_proj": "wk",
+    "self_attn.v_proj": "wv",
+    "self_attn.o_proj": "wo",
+    "mlp.gate_proj": "w_gate",
+    "mlp.up_proj": "w_up",
+    "mlp.down_proj": "w_down",
+}
+_LAYER_RE = re.compile(r"layers\.(\d+)\.(.+)$")
+
+
+def _iter_tensors(path: str) -> Iterator[tuple[str, np.ndarray]]:
+    """Yield (name, array) from a .safetensors / .pt file or a dir of
+    them (same formats the reference accepts, weight.py:986-996)."""
+    files = []
+    if os.path.isdir(path):
+        for n in sorted(os.listdir(path)):
+            if n.endswith((".safetensors", ".pt", ".bin")):
+                files.append(os.path.join(path, n))
+    else:
+        files = [path]
+    if not files:
+        raise ModelLoadError(f"no checkpoint tensors under {path}")
+    for f in files:
+        if f.endswith(".safetensors"):
+            from safetensors.numpy import safe_open
+            with safe_open(f, framework="numpy") as sf:
+                for key in sf.keys():
+                    yield key, sf.get_tensor(key)
+        else:
+            import torch
+            state = torch.load(f, map_location="cpu", weights_only=True)
+            for key, t in state.items():
+                yield key, t.to(torch.float32).numpy() \
+                    if t.dtype in (torch.float16, torch.bfloat16) \
+                    else t.numpy()
+
+
+def sniff_quantized_format(path: str) -> str:
+    """'gptq' | 'awq' | '' by tensor NAMES only — no tensor reads.
+
+    safetensors names come from the file header; torch .pt/.bin archives
+    are zipfiles whose embedded pickle carries the state-dict keys as raw
+    strings, so a substring scan of ``data.pkl`` identifies the format
+    without deserializing multi-GB weights (or choking on
+    non-state-dict binaries like training_args.bin)."""
+    files = []
+    if os.path.isdir(path):
+        for n in sorted(os.listdir(path)):
+            if n.endswith((".safetensors", ".pt", ".bin")):
+                files.append(os.path.join(path, n))
+    elif os.path.isfile(path):
+        files = [path]
+    for f in files:
+        try:
+            if f.endswith(".safetensors"):
+                from safetensors.numpy import safe_open
+                with safe_open(f, framework="numpy") as sf:
+                    for key in sf.keys():
+                        if key.endswith(".qweight"):
+                            return "gptq"
+                        if key.endswith("weight_quantizer._amax"):
+                            return "awq"
+            else:
+                import zipfile
+                with zipfile.ZipFile(f) as z:
+                    pkl = next((n for n in z.namelist()
+                                if n.endswith("data.pkl")), None)
+                    if pkl is None:
+                        continue
+                    blob = z.read(pkl)
+                    if b".qweight" in blob:
+                        return "gptq"
+                    if b"weight_quantizer._amax" in blob:
+                        return "awq"
+        except Exception:  # noqa: BLE001 — unreadable: not ours to claim
+            continue
+    return ""
+
+
+def _unpack_nibbles(packed: np.ndarray, axis: int) -> np.ndarray:
+    """int32-packed uint4 -> uint8 (0..15), expanding ``axis`` by 8
+    (little-endian nibble order: value j at bits 4j — the same order the
+    reference's unpack_int32_into_int8 produces, weight.py:999-1006)."""
+    p = packed.astype(np.uint32)
+    parts = [((p >> (4 * j)) & 0xF).astype(np.uint8) for j in range(8)]
+    return np.stack(parts, axis=axis + 1).reshape(
+        *p.shape[:axis], p.shape[axis] * 8, *p.shape[axis + 1:])
+
+
+def _pack_q4(q: np.ndarray) -> np.ndarray:
+    """Signed int4 (K, N) -> packed int8 (K/2, N), low nibble = even k
+    (ops/quant.py layout)."""
+    return ((q[0::2, :] & 0x0F) | (q[1::2, :] << 4)).astype(np.int8)
+
+
+def _gptq_leaf(qweight: np.ndarray, qzeros: np.ndarray,
+               scales: np.ndarray) -> dict[str, np.ndarray]:
+    u = _unpack_nibbles(qweight, axis=0)            # (K, N) uint8
+    q = u.astype(np.int8) - 8                       # signed int4
+    uz = _unpack_nibbles(qzeros, axis=1)            # (G, N) uint8
+    s = scales.astype(np.float32)
+    gbias = (7.0 - uz.astype(np.float32)) * s       # w = q*s + (7-uz)*s
+    return {"q4": _pack_q4(q), "gscale": s, "gbias": gbias}
+
+
+def _awq_leaf(weight: np.ndarray, amax: np.ndarray,
+              pre_scale: np.ndarray) -> dict[str, np.ndarray]:
+    # AMMO stores weight (N_out, K); we use (K, N).
+    wT = weight.astype(np.float32).T                # (K, N)
+    K, N = wT.shape
+    G = amax.size // N
+    s = (amax.astype(np.float32).reshape(N, G).T / 8.0)  # (G, N)
+    s = np.maximum(s, 1e-12)
+    q = np.clip(np.round(wT / np.repeat(s, K // G, axis=0)),
+                -8, 7).astype(np.int8)
+    return {"q4": _pack_q4(q), "gscale": s,
+            "pre_scale": pre_scale.astype(np.float32).reshape(-1)}
+
+
+def _stack_leaves(per_layer: list[dict[str, np.ndarray]],
+                  dtype=jnp.float32) -> dict[str, jnp.ndarray]:
+    keys = per_layer[0].keys()
+    return {k: jnp.asarray(np.stack([d[k] for d in per_layer], axis=0))
+            for k in keys}
+
+
+def load_quantized_checkpoint(path: str, cfg: LlamaConfig,
+                              dtype: jnp.dtype = jnp.bfloat16) -> Params:
+    """Load a GPTQ or AWQ checkpoint into a stacked llama param tree with
+    group-wise int4 leaves. Plain tensors (embeddings, norms, lm_head)
+    load at ``dtype``."""
+    fmt = sniff_quantized_format(path)
+    if not fmt:
+        raise ModelLoadError(f"{path}: neither GPTQ (.qweight) nor AWQ "
+                             "(weight_quantizer._amax) tensors found")
+    L = cfg.num_layers
+    raw: dict[str, np.ndarray] = {}
+    for key, arr in _iter_tensors(path):
+        raw[key.removeprefix("model.")] = arr
+
+    if any(k.endswith(".g_idx") for k in raw):
+        for k in (k for k in raw if k.endswith(".g_idx")):
+            g = raw[k]
+            group = g.size // (g.max() + 1) if g.size else 1
+            if not np.array_equal(g, np.arange(g.size) // max(group, 1)):
+                raise ModelLoadError(
+                    "GPTQ checkpoint uses act-order (non-trivial g_idx); "
+                    "reorder it with sequential groups before importing")
+
+    layer_acc: dict[str, list] = {name: [None] * L
+                                  for name in _PROJ_KEYS.values()}
+    norms: dict[str, list] = {"attn_norm": [None] * L,
+                              "mlp_norm": [None] * L}
+    top: dict[str, np.ndarray] = {}
+
+    for key, arr in raw.items():
+        if key == "embed_tokens.weight":
+            top["embed"] = arr
+        elif key == "norm.weight":
+            top["final_norm"] = arr
+        elif key == "lm_head.weight":
+            top["lm_head"] = arr.T
+        m = _LAYER_RE.match(key)
+        if not m:
+            continue
+        idx, rest = int(m.group(1)), m.group(2)
+        if rest == "input_layernorm.weight":
+            norms["attn_norm"][idx] = arr
+            continue
+        if rest == "post_attention_layernorm.weight":
+            norms["mlp_norm"][idx] = arr
+            continue
+        for proj, ours in _PROJ_KEYS.items():
+            if not rest.startswith(proj + "."):
+                continue
+            if fmt == "gptq" and rest == f"{proj}.qweight":
+                layer_acc[ours][idx] = _gptq_leaf(
+                    arr, raw[f"layers.{idx}.{proj}.qzeros"],
+                    raw[f"layers.{idx}.{proj}.scales"])
+            elif fmt == "awq" and rest == f"{proj}.weight":
+                layer_acc[ours][idx] = _awq_leaf(
+                    arr, raw[f"layers.{idx}.{proj}."
+                             "weight_quantizer._amax"],
+                    raw[f"layers.{idx}.{proj}."
+                        "input_quantizer._pre_quant_scale"])
+            break
+
+    missing = [f"{k}[{i}]" for k, v in {**layer_acc, **norms}.items()
+               for i, x in enumerate(v) if x is None]
+    if missing or "embed" not in top or "final_norm" not in top:
+        raise ModelLoadError(
+            f"incomplete quantized checkpoint ({sorted(missing)[:5]}...)")
+
+    layers: dict[str, Any] = {
+        name: _stack_leaves(acc) for name, acc in layer_acc.items()}
+    layers["attn_norm"] = jnp.asarray(np.stack(norms["attn_norm"]), dtype)
+    layers["mlp_norm"] = jnp.asarray(np.stack(norms["mlp_norm"]), dtype)
+
+    params: Params = {
+        "embed": jnp.asarray(top["embed"], dtype),
+        "layers": layers,
+        "final_norm": jnp.asarray(top["final_norm"], dtype),
+    }
+    if "lm_head" in top:
+        params["lm_head"] = jnp.asarray(top["lm_head"], dtype)
+    elif not cfg.tie_word_embeddings:
+        raise ModelLoadError("quantized checkpoint has no lm_head and "
+                             "config does not tie embeddings")
+    return params

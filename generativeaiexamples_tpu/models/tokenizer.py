@@ -26,6 +26,7 @@ class Tokenizer(Protocol):
     def vocab_size(self) -> int: ...
     def encode(self, text: str, *, add_bos: bool = True) -> list[int]: ...
     def decode(self, ids: Sequence[int]) -> str: ...
+    def piece_id(self, piece: str) -> "int | None": ...
 
 
 class ByteTokenizer:
@@ -51,6 +52,10 @@ class ByteTokenizer:
         data = bytes(i - self._OFFSET for i in ids
                      if i >= self._OFFSET and i < self._OFFSET + 256)
         return data.decode("utf-8", errors="replace")
+
+    def piece_id(self, piece: str) -> "int | None":
+        data = piece.encode("utf-8")
+        return data[0] + self._OFFSET if len(data) == 1 else None
 
 
 class HFTokenizer:
@@ -83,9 +88,26 @@ class HFTokenizer:
     def decode(self, ids: Sequence[int]) -> str:
         return self._tok.decode(list(ids), skip_special_tokens=True)
 
+    def piece_id(self, piece: str) -> "int | None":
+        return self._tok.token_to_id(piece)
+
 
 def get_tokenizer(spec: str = "byte") -> Tokenizer:
-    """Factory: 'byte' or a path to a tokenizer.json / HF model dir."""
+    """Factory: 'byte', or a path to a checkpoint dir / tokenizer file.
+
+    Checkpoint dirs resolve in the order real Llama-2 releases ship them:
+    ``tokenizer.model`` (sentencepiece — loaded by the self-contained
+    reader in models/sentencepiece.py since no sentencepiece wheel is
+    assumed), then ``tokenizer.json`` (HF tokenizers).
+    """
     if spec == "byte":
         return ByteTokenizer()
+    from .sentencepiece import SentencePieceTokenizer
+    if os.path.isdir(spec):
+        sp = os.path.join(spec, "tokenizer.model")
+        if os.path.isfile(sp):
+            return SentencePieceTokenizer(sp)
+        return HFTokenizer(spec)
+    if spec.endswith(".model"):
+        return SentencePieceTokenizer(spec)
     return HFTokenizer(spec)

@@ -1,19 +1,25 @@
-"""Weight-only quantization: symmetric per-channel int8 / packed int4.
+"""Weight-only quantization: per-channel int8/int4 + group-wise int4.
 
-Parity point: the reference offers int4-AWQ / int8 weight-only engines
-(reference: conversion/llama.py:81-97 ``--quantization int4_awq``,
-conversion_scripts/llama/build.py:543-580 QuantMode wiring). TPU-idiomatic
-version: weights live in HBM as int8 (int4 packed two-per-byte), and XLA
-fuses the dequantize (cast + scale) into the matmul prologue — the MXU
-still sees bf16 operands, but HBM traffic and footprint drop 2-4x, which
-is what matters for weight-bound decode.
+Parity point: the reference offers int8 / int4 / int4-AWQ / GPTQ
+weight-only engines (reference: conversion/llama.py:81-97
+``--quantization int4_awq``, conversion_scripts/llama/build.py:543-580
+QuantMode wiring, weight.py:979 GPTQ / :1194 AWQ loaders).
+TPU-idiomatic version: weights live in HBM as int8 (int4 packed
+two-per-byte), and the matmul consumes them via mixed-dtype dots (per
+channel) or per-group partial dots — the MXU still sees bf16 operands,
+but HBM traffic and footprint drop 2-4x, which is what matters for
+weight-bound decode.
 
 A quantized tensor is a dict leaf:
-  int8: ``{"q":  int8[..., K, N],   "scale": f32[..., N]}``
-  int4: ``{"q4": int8[..., K/2, N], "scale": f32[..., N]}``  (two nibbles
-         per byte along the reduction axis, low nibble = even k)
-Every leaf is an array and weight rank is preserved, so one PartitionSpec
-tree serves raw and quantized params alike.
+  int8:        ``{"q":  int8[..., K, N],   "scale": f32[..., N]}``
+  int4:        ``{"q4": int8[..., K/2, N], "scale": f32[..., N]}``
+  group int4:  ``{"q4": int8[..., K/2, N], "gscale": f32[..., G, N]}``
+               + optional ``"gbias"`` f32[..., G, N] (asymmetric zeros,
+               GPTQ) and ``"pre_scale"`` f32[..., K] (AWQ activation
+               smoothing scale), with G = K / group_size.
+(int4 packs two nibbles per byte along the reduction axis, low nibble =
+even k.) Every leaf is an array and weight rank is preserved, so one
+PartitionSpec tree serves raw and quantized params alike.
 """
 
 from __future__ import annotations
@@ -32,7 +38,12 @@ _QUANT_LAYER_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
 
 
 def is_quantized(w: Any) -> bool:
-    return isinstance(w, dict) and "scale" in w and ("q" in w or "q4" in w)
+    return isinstance(w, dict) and (
+        ("scale" in w or "gscale" in w) and ("q" in w or "q4" in w))
+
+
+def is_grouped(w: Any) -> bool:
+    return isinstance(w, dict) and "gscale" in w
 
 
 def quantize_tensor(w: jax.Array, bits: int = 8) -> QTensor:
@@ -71,9 +82,43 @@ def _int_weights(w: QTensor) -> jax.Array:
     return _unpack4(w["q4"]) if "q4" in w else w["q"]
 
 
+def quantize_tensor_grouped(w: jax.Array, group_size: int = 128) -> QTensor:
+    """Group-wise symmetric int4 (the AWQ storage format: per-(group, out)
+    scales = absmax/8 over each ``group_size`` slice of the reduction
+    axis — reference weight.py:1290 ``get_scale``; the activation-aware
+    scale *search* needs calibration data and lives in the importer)."""
+    K, N = w.shape[-2], w.shape[-1]
+    if K % group_size:
+        raise ValueError(f"reduction dim {K} not divisible by group "
+                         f"{group_size}")
+    G = K // group_size
+    wf = w.astype(jnp.float32).reshape(*w.shape[:-2], G, group_size, N)
+    absmax = jnp.max(jnp.abs(wf), axis=-2)                     # (..., G, N)
+    gscale = jnp.maximum(absmax / 7.0, 1e-12)
+    q = jnp.clip(jnp.round(wf / gscale[..., None, :]), -7, 7
+                 ).astype(jnp.int8)
+    q = q.reshape(*w.shape[:-2], K, N)
+    packed = ((q[..., 0::2, :] & 0x0F) | (q[..., 1::2, :] << 4)
+              ).astype(jnp.int8)
+    return {"q4": packed, "gscale": gscale.astype(jnp.float32)}
+
+
 def dequantize(w: QTensor, dtype=jnp.bfloat16) -> jax.Array:
-    q = _int_weights(w)
-    return (q.astype(jnp.float32) * w["scale"][..., None, :]).astype(dtype)
+    q = _int_weights(w).astype(jnp.float32)
+    if is_grouped(w):
+        K, N = q.shape[-2], q.shape[-1]
+        G = w["gscale"].shape[-2]
+        qg = q.reshape(*q.shape[:-2], G, K // G, N)
+        out = qg * w["gscale"][..., None, :]
+        if "gbias" in w:
+            out = out + w["gbias"][..., None, :]
+        out = out.reshape(q.shape)
+        if "pre_scale" in w:
+            # fold the activation smoothing scale back for an effective
+            # full-precision view: y = (x*s) @ W  ==  x @ (s[:,None]*W)
+            out = out * w["pre_scale"][..., :, None]
+        return out.astype(dtype)
+    return (q * w["scale"][..., None, :]).astype(dtype)
 
 
 def matmul(x: jax.Array, w: Union[jax.Array, QTensor]) -> jax.Array:
@@ -91,6 +136,8 @@ def matmul(x: jax.Array, w: Union[jax.Array, QTensor]) -> jax.Array:
     if not is_quantized(w):
         return x @ w
     q = _int_weights(w)
+    if is_grouped(w):
+        return _grouped_matmul(x, q, w)
     dims = (((x.ndim - 1,), (q.ndim - 2,)), ((), ()))
     try:
         y = jax.lax.dot_general(x, q, dims,
@@ -100,14 +147,49 @@ def matmul(x: jax.Array, w: Union[jax.Array, QTensor]) -> jax.Array:
     return (y * w["scale"]).astype(x.dtype)
 
 
-def quantize_params(params: Any, mode: str = "int8") -> Any:
+def _grouped_matmul(x: jax.Array, q: jax.Array, w: QTensor) -> jax.Array:
+    """Group-wise dequant matmul without materializing the weight:
+    per-group partial dots scaled by (G, N) scales, plus a rank-1 bias
+    term for asymmetric (GPTQ) zeros:
+      y[n] = sum_g dot(x_g, q_g)[n] * s[g,n]  +  sum_g (sum x_g) b[g,n]
+    """
+    if q.ndim != 2:
+        raise ValueError("grouped quantization supports 2D weights only")
+    K, N = q.shape
+    G = w["gscale"].shape[-2]
+    group = K // G
+    lead = x.shape[:-1]
+    xf = x.astype(jnp.float32)
+    if "pre_scale" in w:
+        xf = xf * w["pre_scale"]
+    xg = xf.reshape(-1, G, group)
+    qg = q.astype(jnp.float32).reshape(G, group, N)
+    p = jnp.einsum("bgk,gkn->bgn", xg, qg)
+    y = jnp.einsum("bgn,gn->bn", p, w["gscale"])
+    if "gbias" in w:
+        y = y + jnp.einsum("bg,gn->bn", jnp.sum(xg, axis=-1), w["gbias"])
+    return y.reshape(*lead, N).astype(x.dtype)
+
+
+def quantize_params(params: Any, mode: str = "int8",
+                    group_size: int = 128) -> Any:
     """Quantize a llama param tree's matmul weights in place of the raw
-    arrays. ``mode``: int8 | int4 | int4_awq (AWQ-format checkpoints load
-    pre-scaled via their importer; applying int4_awq to raw weights falls
-    back to plain int4)."""
-    bits = {"int8": 8, "int4": 4, "int4_awq": 4}.get(mode)
-    if bits is None:
+    arrays. ``mode``: int8 | int4 (per-channel) | int4_awq (group-wise
+    AWQ storage format; pre-quantized AWQ/GPTQ checkpoints instead load
+    their own scales via models/import_quantized.py)."""
+    if mode not in ("int8", "int4", "int4_awq"):
         raise ValueError(f"unknown quantization mode {mode!r}")
+
+    def quant(w):
+        if mode == "int4_awq":
+            # stacked (L, K, N) per-layer weights: group along K per layer
+            if w.ndim == 3:
+                import jax as _jax
+                return _jax.vmap(
+                    lambda m: quantize_tensor_grouped(m, group_size))(w)
+            return quantize_tensor_grouped(w, group_size)
+        return quantize_tensor(w, 8 if mode == "int8" else 4)
+
     out = dict(params)
     layers = dict(params["layers"])
     for key in _QUANT_LAYER_KEYS:
@@ -115,8 +197,8 @@ def quantize_params(params: Any, mode: str = "int8") -> Any:
         # expert einsums contract differently than plain matmul.
         if (key in layers and not is_quantized(layers[key])
                 and layers[key].ndim <= 3):
-            layers[key] = quantize_tensor(layers[key], bits)
+            layers[key] = quant(layers[key])
     out["layers"] = layers
     if "lm_head" in out and not is_quantized(out["lm_head"]):
-        out["lm_head"] = quantize_tensor(out["lm_head"], bits)
+        out["lm_head"] = quant(out["lm_head"])
     return out

@@ -127,8 +127,19 @@ def shard_params(params: Any, mesh: Mesh, specs: Any) -> Any:
                 w_spec = tuple(s)
                 scale_spec = (P(*(w_spec[:-2] + w_spec[-1:]))
                               if len(w_spec) >= 2 else P())
-                return {k: place(v, s if k in ("q", "q4") else scale_spec)
-                        for k, v in p.items()}
+
+                def leaf_spec(k):
+                    if k in ("q", "q4"):
+                        return s
+                    if k in ("gscale", "gbias"):
+                        # (..., G, N): same rank as the weight — the
+                        # group axis stands where K stood
+                        return P(*w_spec)
+                    if k == "pre_scale":
+                        return (P(*w_spec[:-1]) if len(w_spec) >= 1
+                                else P())
+                    return scale_spec
+                return {k: place(v, leaf_spec(k)) for k, v in p.items()}
             return {k: walk(v, s[k]) for k, v in p.items()}
         return place(p, s)
 

@@ -74,6 +74,9 @@ class LLMServicer:
     def Generate(self, request, context) -> pb.GenerateResponse:
         timer = obs_metrics.RequestTimer("grpc_generate")
         stream = self._submit(request, context)
+        # deadline/disconnect must release the decode slot, not keep
+        # generating to max_tokens
+        context.add_callback(stream.cancel)
         try:
             chunks = []
             for chunk in stream:
@@ -112,7 +115,7 @@ class LLMServicer:
         if not texts:
             return pb.EmbedResponse(dim=self.embed_service.dim)
         if request.input_type == "query":
-            rows = [self.embed_service.embed_query(t) for t in texts]
+            rows = list(self.embed_service.embed_queries(texts))
         else:
             rows = list(self.embed_service.embed_documents(texts))
         flat = [float(x) for row in rows for x in row]

@@ -1,0 +1,303 @@
+"""Quantized + NeMo checkpoint import tests.
+
+Golden dequant parity (the VERDICT #4 done-criterion): synthetic GPTQ and
+AWQ checkpoints are constructed with known values using the exact wire
+formats the reference loaders consume (weight.py:979 GPTQ int32-packed
+qweight/qzeros/scales; weight.py:1194 AMMO-AWQ weight/_amax/
+_pre_quant_scale), imported, and compared against hand-computed
+dequantization."""
+
+import io
+import os
+import tarfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+import yaml
+
+from generativeaiexamples_tpu.models import llama
+from generativeaiexamples_tpu.models.configs import LLAMA_TINY, LlamaConfig
+from generativeaiexamples_tpu.models.import_hf import (
+    detect_checkpoint_format, load_checkpoint)
+from generativeaiexamples_tpu.models.import_quantized import (
+    load_quantized_checkpoint, sniff_quantized_format)
+from generativeaiexamples_tpu.ops.quant import (dequantize, matmul,
+                                                quantize_params,
+                                                quantize_tensor_grouped)
+
+# tiny geometry: D=16, F=32, L=2, H=4, KV=2, hd=4, V=64, group=8
+TINY = LlamaConfig(vocab_size=64, hidden_size=16, intermediate_size=32,
+                   num_layers=2, num_heads=4, num_kv_heads=2, head_dim=4,
+                   max_position_embeddings=64)
+GROUP = 8
+
+_PROJS = {
+    "self_attn.q_proj": (16, 16), "self_attn.k_proj": (16, 8),
+    "self_attn.v_proj": (16, 8), "self_attn.o_proj": (16, 16),
+    "mlp.gate_proj": (16, 32), "mlp.up_proj": (16, 32),
+    "mlp.down_proj": (32, 16),
+}
+
+
+def _pack_int32(u4: np.ndarray, axis: int) -> np.ndarray:
+    """uint4 values -> int32-packed along ``axis`` (little-endian nibble
+    order), the GPTQ layout."""
+    u = np.moveaxis(u4.astype(np.uint32), axis, 0)
+    out = np.zeros((u.shape[0] // 8, *u.shape[1:]), np.uint32)
+    for j in range(8):
+        out |= u[j::8] << (4 * j)
+    return np.moveaxis(out.view(np.int32), 0, axis)
+
+
+def _rng(seed):
+    return np.random.default_rng(seed)
+
+
+def _make_gptq_proj(rng, K, N):
+    """Random GPTQ triple + its exact dequantized weight."""
+    G = K // GROUP
+    u = rng.integers(0, 16, size=(K, N), dtype=np.uint8)
+    uz = rng.integers(0, 15, size=(G, N), dtype=np.uint8)
+    s = rng.uniform(0.01, 0.2, size=(G, N)).astype(np.float32)
+    w = (u.astype(np.float32)
+         - 1.0 - np.repeat(uz, GROUP, axis=0)) * np.repeat(s, GROUP, axis=0)
+    return {"qweight": _pack_int32(u, 0), "qzeros": _pack_int32(uz, 1),
+            "scales": s}, w
+
+
+def _gptq_checkpoint(tmp_path):
+    rng = _rng(0)
+    state: dict[str, torch.Tensor] = {}
+    golden: dict[str, np.ndarray] = {}
+    for i in range(TINY.num_layers):
+        for proj, (K, N) in _PROJS.items():
+            triple, w = _make_gptq_proj(rng, K, N)
+            for suffix, arr in triple.items():
+                state[f"model.layers.{i}.{proj}.{suffix}"] = \
+                    torch.from_numpy(arr)
+            golden[f"{i}.{proj}"] = w
+        state[f"model.layers.{i}.input_layernorm.weight"] = \
+            torch.ones(TINY.hidden_size)
+        state[f"model.layers.{i}.post_attention_layernorm.weight"] = \
+            torch.ones(TINY.hidden_size)
+    state["model.embed_tokens.weight"] = torch.from_numpy(
+        rng.standard_normal((TINY.vocab_size, TINY.hidden_size)
+                            ).astype(np.float32))
+    state["model.norm.weight"] = torch.ones(TINY.hidden_size)
+    state["lm_head.weight"] = torch.from_numpy(
+        rng.standard_normal((TINY.vocab_size, TINY.hidden_size)
+                            ).astype(np.float32))
+    path = os.path.join(tmp_path, "gptq")
+    os.makedirs(path, exist_ok=True)
+    torch.save(state, os.path.join(path, "model_quantized.pt"))
+    return path, golden
+
+
+def test_gptq_golden_dequant_parity(tmp_path):
+    path, golden = _gptq_checkpoint(tmp_path)
+    assert sniff_quantized_format(path) == "gptq"
+    assert detect_checkpoint_format(path) == "gptq"
+    params = load_quantized_checkpoint(path, TINY, dtype=jnp.float32)
+    for i in range(TINY.num_layers):
+        leaf = {k: v[i] for k, v in params["layers"]["wq"].items()}
+        deq = np.asarray(dequantize(leaf, jnp.float32))
+        np.testing.assert_allclose(
+            deq, golden[f"{i}.self_attn.q_proj"], rtol=1e-4, atol=1e-4)
+
+
+def test_gptq_matmul_matches_dequant(tmp_path):
+    path, golden = _gptq_checkpoint(tmp_path)
+    params = load_quantized_checkpoint(path, TINY, dtype=jnp.float32)
+    leaf = {k: v[0] for k, v in params["layers"]["w_up"].items()}
+    x = jnp.asarray(_rng(3).standard_normal((2, 16)).astype(np.float32))
+    y = np.asarray(matmul(x, leaf))
+    expect = np.asarray(x) @ golden["0.mlp.up_proj"]
+    np.testing.assert_allclose(y, expect, rtol=1e-3, atol=1e-3)
+
+
+def test_gptq_forward_runs(tmp_path):
+    path, _ = _gptq_checkpoint(tmp_path)
+    params = load_quantized_checkpoint(path, TINY, dtype=jnp.float32)
+    tokens = jnp.asarray([[1, 5, 9]], jnp.int32)
+    positions = jnp.arange(3, dtype=jnp.int32)[None, :]
+    logits, _ = llama.apply(params, TINY, tokens, positions)
+    assert logits.shape == (1, 3, TINY.vocab_size)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def _awq_checkpoint(tmp_path):
+    rng = _rng(1)
+    state: dict[str, torch.Tensor] = {}
+    for i in range(TINY.num_layers):
+        for proj, (K, N) in _PROJS.items():
+            w = rng.standard_normal((N, K)).astype(np.float32)  # (out, in)
+            G = K // GROUP
+            amax = np.abs(w).reshape(N, G, GROUP).max(-1).astype(np.float32)
+            pre = rng.uniform(0.5, 2.0, size=(K,)).astype(np.float32)
+            base = f"model.layers.{i}.{proj}"
+            state[f"{base}.weight"] = torch.from_numpy(w)
+            state[f"{base}.weight_quantizer._amax"] = \
+                torch.from_numpy(amax.reshape(N, G))
+            state[f"{base}.input_quantizer._pre_quant_scale"] = \
+                torch.from_numpy(pre)
+        state[f"model.layers.{i}.input_layernorm.weight"] = \
+            torch.ones(TINY.hidden_size)
+        state[f"model.layers.{i}.post_attention_layernorm.weight"] = \
+            torch.ones(TINY.hidden_size)
+    state["model.embed_tokens.weight"] = torch.from_numpy(
+        rng.standard_normal((TINY.vocab_size, TINY.hidden_size)
+                            ).astype(np.float32))
+    state["model.norm.weight"] = torch.ones(TINY.hidden_size)
+    state["lm_head.weight"] = torch.from_numpy(
+        rng.standard_normal((TINY.vocab_size, TINY.hidden_size)
+                            ).astype(np.float32))
+    path = os.path.join(tmp_path, "awq")
+    os.makedirs(path, exist_ok=True)
+    torch.save(state, os.path.join(path, "model_awq.pt"))
+    return path, state
+
+
+def test_awq_import_parity(tmp_path):
+    path, state = _awq_checkpoint(tmp_path)
+    assert sniff_quantized_format(path) == "awq"
+    params = load_quantized_checkpoint(path, TINY, dtype=jnp.float32)
+    leaf = {k: v[0] for k, v in params["layers"]["wq"].items()}
+    w = state["model.layers.0.self_attn.q_proj.weight"].numpy().T  # (K,N)
+    pre = state["model.layers.0.self_attn.q_proj."
+                "input_quantizer._pre_quant_scale"].numpy()
+    # dequantize folds pre_scale in: effective weight ~= diag(pre) @ W
+    deq = np.asarray(dequantize(leaf, jnp.float32))
+    expect = pre[:, None] * w
+    # int4 grouped quantization error bound: half an LSB of each group's
+    # scale (amax/8), scaled by the folded pre_scale
+    K, N = w.shape
+    G = K // GROUP
+    amax = np.abs(w.T).reshape(N, G, GROUP).max(-1)        # (N, G)
+    scale_rep = np.repeat(amax.T / 8.0, GROUP, axis=0)     # (K, N)
+    err = np.abs(deq - expect)
+    # 0.5 LSB rounding, except positive group maxima: round(w/s)=8 clips
+    # to 7 (the reference's [-8,7] convention) -> up to 1 LSB there
+    bound = pre[:, None] * scale_rep * 1.01 + 1e-6
+    assert (err <= bound).all()
+    # matmul path is EXACT vs the dequantized weight: (x*pre) @ W_q
+    # == x @ (pre[:,None]*W_q) == x @ deq
+    x = jnp.asarray(_rng(5).standard_normal((3, 16)).astype(np.float32))
+    y = np.asarray(matmul(x, leaf))
+    np.testing.assert_allclose(y, np.asarray(x) @ deq, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_int4_awq_mode_quantizes_grouped_and_runs():
+    params = llama.init_params(TINY, jax.random.key(0), dtype=jnp.float32)
+    qparams = quantize_params(params, "int4_awq", group_size=GROUP)
+    assert "gscale" in qparams["layers"]["wq"]
+    assert qparams["layers"]["wq"]["q4"].shape[1] == TINY.hidden_size // 2
+    tokens = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    positions = jnp.arange(4, dtype=jnp.int32)[None, :]
+    logits, _ = llama.apply(qparams, TINY, tokens, positions)
+    ref_logits, _ = llama.apply(params, TINY, tokens, positions)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+    # int4 grouped tracks the fp forward. The bar is loose because tiny
+    # random-init weights have no dominant directions, so relative
+    # quantization noise is near worst-case (real checkpoints fare far
+    # better).
+    cos = float(jnp.sum(logits * ref_logits) /
+                (jnp.linalg.norm(logits) * jnp.linalg.norm(ref_logits)))
+    assert cos > 0.85
+
+
+def test_grouped_quantize_roundtrip():
+    w = jnp.asarray(_rng(7).standard_normal((32, 8)).astype(np.float32))
+    leaf = quantize_tensor_grouped(w, group_size=8)
+    deq = dequantize(leaf, jnp.float32)
+    scale_rep = jnp.repeat(leaf["gscale"], 8, axis=0)
+    assert float(jnp.max(jnp.abs(deq - w) / scale_rep)) <= 0.5 + 1e-3
+
+
+# ---------------------------------------------------------------- .nemo
+
+def _nemo_checkpoint(tmp_path):
+    """Fuse a known param tree into megatron naming, tar it up."""
+    rng = _rng(11)
+    cfg = TINY
+    D, F, hd, KV = cfg.hidden_size, cfg.intermediate_size, cfg.head_dim, \
+        cfg.num_kv_heads
+    g = cfg.num_heads // KV
+    state: dict[str, torch.Tensor] = {}
+    golden: dict[str, np.ndarray] = {}
+    P = "model.language_model."
+    for i in range(cfg.num_layers):
+        base = f"{P}encoder.layers.{i}."
+        q = rng.standard_normal((cfg.num_heads * hd, D)).astype(np.float32)
+        k = rng.standard_normal((KV * hd, D)).astype(np.float32)
+        v = rng.standard_normal((KV * hd, D)).astype(np.float32)
+        fused = np.concatenate([
+            np.concatenate([q.reshape(KV, g * hd, D)[kv],
+                            k.reshape(KV, hd, D)[kv],
+                            v.reshape(KV, hd, D)[kv]], axis=0)
+            for kv in range(KV)], axis=0)
+        state[base + "self_attention.query_key_value.weight"] = \
+            torch.from_numpy(fused)
+        golden[f"{i}.wq"], golden[f"{i}.wk"], golden[f"{i}.wv"] = \
+            q.T, k.T, v.T
+        wo = rng.standard_normal((D, cfg.num_heads * hd)).astype(np.float32)
+        state[base + "self_attention.dense.weight"] = torch.from_numpy(wo)
+        golden[f"{i}.wo"] = wo.T
+        gate = rng.standard_normal((F, D)).astype(np.float32)
+        up = rng.standard_normal((F, D)).astype(np.float32)
+        state[base + "mlp.dense_h_to_4h.weight"] = torch.from_numpy(
+            np.concatenate([gate, up], axis=0))
+        golden[f"{i}.w_gate"], golden[f"{i}.w_up"] = gate.T, up.T
+        down = rng.standard_normal((D, F)).astype(np.float32)
+        state[base + "mlp.dense_4h_to_h.weight"] = torch.from_numpy(down)
+        golden[f"{i}.w_down"] = down.T
+        state[base + "input_layernorm.weight"] = torch.ones(D)
+        state[base + "post_attention_layernorm.weight"] = torch.ones(D)
+    state[P + "embedding.word_embeddings.weight"] = torch.from_numpy(
+        rng.standard_normal((cfg.vocab_size, D)).astype(np.float32))
+    state[P + "encoder.final_layernorm.weight"] = torch.ones(D)
+    state[P + "output_layer.weight"] = torch.from_numpy(
+        rng.standard_normal((cfg.vocab_size, D)).astype(np.float32))
+
+    nemo = os.path.join(tmp_path, "tiny.nemo")
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        ckpt = os.path.join(td, "model_weights.ckpt")
+        torch.save(state, ckpt)
+        cfg_yaml = os.path.join(td, "model_config.yaml")
+        with open(cfg_yaml, "w") as f:
+            yaml.safe_dump({"num_layers": cfg.num_layers,
+                            "hidden_size": D}, f)
+        with tarfile.open(nemo, "w") as tar:
+            tar.add(cfg_yaml, arcname="model_config.yaml")
+            tar.add(ckpt, arcname="model_weights.ckpt")
+    return nemo, golden
+
+
+def test_nemo_import_roundtrip(tmp_path):
+    nemo, golden = _nemo_checkpoint(tmp_path)
+    assert detect_checkpoint_format(os.path.dirname(nemo)) == "nemo"
+    params = load_checkpoint(os.path.dirname(nemo), TINY,
+                             dtype=jnp.float32)
+    for i in range(TINY.num_layers):
+        for key in ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"):
+            np.testing.assert_allclose(
+                np.asarray(params["layers"][key][i]), golden[f"{i}.{key}"],
+                rtol=1e-6, err_msg=f"layer {i} {key}")
+    logits, _ = llama.apply(params, TINY, jnp.asarray([[1, 2]], jnp.int32),
+                            jnp.arange(2, dtype=jnp.int32)[None, :])
+    assert logits.shape == (1, 2, TINY.vocab_size)
+
+
+def test_nemo_config_mismatch_rejected(tmp_path):
+    nemo, _ = _nemo_checkpoint(tmp_path)
+    from generativeaiexamples_tpu.models.import_nemo import (
+        load_nemo_checkpoint)
+    from generativeaiexamples_tpu.utils.errors import ModelLoadError
+    bad = LlamaConfig(vocab_size=64, hidden_size=16, intermediate_size=32,
+                      num_layers=3, num_heads=4, num_kv_heads=2, head_dim=4)
+    with pytest.raises(ModelLoadError):
+        load_nemo_checkpoint(nemo, bad)
