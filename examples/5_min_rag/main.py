@@ -1,0 +1,130 @@
+"""5-minute RAG — one file, no accelerator required.
+
+The TPU-stack equivalent of the reference's minimal standalone example
+(reference: examples/5_mins_rag_no_gpu/main.py — a single-file Streamlit
+RAG over cloud endpoints + pickled FAISS). Same four components, each a
+few lines against this framework instead of cloud services:
+
+  #1 document loading    chains.readers + TokenTextSplitter
+  #2 embedder + LLM      embed.get_embedder / chains.llm.get_llm
+  #3 vector store        retrieval.DocumentIndex (exact, in-process)
+  #4 chat loop           a tiny built-in web page (this image has no
+                         streamlit; the page needs only a browser)
+
+Run it:
+  python examples/5_min_rag/main.py --docs ./my_docs
+Then open http://localhost:8099. With no flags it runs fully offline on
+the dev stack (hash embedder + echo LLM). Point it at a real serving
+stack with:
+  python examples/5_min_rag/main.py --llm openai-compat \
+      --server-url http://localhost:8000 --embedder tpu-jax
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+from aiohttp import web  # noqa: E402
+
+from generativeaiexamples_tpu.chains.llm import EchoLLM, OpenAICompatLLM  # noqa: E402
+from generativeaiexamples_tpu.chains.readers import read_document  # noqa: E402
+from generativeaiexamples_tpu.chains.splitter import (TokenTextSplitter,  # noqa: E402
+                                                      cap_context)
+from generativeaiexamples_tpu.embed.encoder import get_embedder  # noqa: E402
+from generativeaiexamples_tpu.retrieval.docstore import DocumentIndex  # noqa: E402
+
+PROMPT = ("Answer the question using only this context:\n\n{context}\n\n"
+          "Question: {question}\nAnswer:")
+
+PAGE = """<!doctype html><html><head><title>5-minute RAG (TPU)</title>
+<style>body{font-family:sans-serif;max-width:46rem;margin:2rem auto}
+#log div{margin:.4rem 0;padding:.5rem;border-radius:6px}
+.q{background:#e8f0fe}.a{background:#f1f3f4;white-space:pre-wrap}</style>
+</head><body><h2>5-minute RAG</h2><div id="log"></div>
+<form id="f"><input id="q" style="width:80%%" placeholder="Ask…">
+<button>Send</button></form><script>
+const log=document.getElementById("log"),q=document.getElementById("q");
+document.getElementById("f").addEventListener("submit",async(e)=>{
+  e.preventDefault();const text=q.value.trim();if(!text)return;q.value="";
+  const add=(c,t)=>{const d=document.createElement("div");d.className=c;
+    d.textContent=t;log.appendChild(d);return d};
+  add("q",text);const a=add("a","");
+  const r=await fetch("/ask",{method:"POST",body:text});
+  const rd=r.body.getReader(),dec=new TextDecoder();
+  for(;;){const{done,value}=await rd.read();if(done)break;
+    a.textContent+=dec.decode(value,{stream:true});}});
+</script></body></html>"""
+
+
+def build_index(docs_dir: str, embedder) -> DocumentIndex:
+    """Component #1 + #3: load, chunk, embed, index."""
+    index = DocumentIndex(embedder, store_name="exact")
+    splitter = TokenTextSplitter(chunk_size=200, chunk_overlap=40)
+    for path in sorted(glob.glob(os.path.join(docs_dir, "*"))):
+        if not os.path.isfile(path):
+            continue
+        try:
+            chunks = splitter.split_text(read_document(path))
+        except Exception as exc:  # noqa: BLE001 — skip unreadable files
+            print(f"skipping {path}: {exc}")
+            continue
+        index.add_texts(chunks, [{"source": os.path.basename(path)}
+                                 for _ in chunks])
+        print(f"indexed {path}: {len(chunks)} chunks")
+    return index
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="5-minute RAG")
+    parser.add_argument("--docs", default="./uploaded_docs")
+    parser.add_argument("--llm", default="echo",
+                        choices=["echo", "openai-compat"])
+    parser.add_argument("--server-url", default="http://localhost:8000")
+    parser.add_argument("--embedder", default="hash",
+                        choices=["hash", "tpu-jax"])
+    parser.add_argument("--port", type=int, default=8099)
+    args = parser.parse_args()
+
+    # Component #2: embedder + LLM
+    embedder = get_embedder(args.embedder, "e5-large-v2", dim=384)
+    llm = (OpenAICompatLLM(args.server_url) if args.llm == "openai-compat"
+           else EchoLLM())
+
+    os.makedirs(args.docs, exist_ok=True)
+    index = build_index(args.docs, embedder)
+    if len(index) == 0:
+        print(f"(no documents in {args.docs} — drop .txt/.pdf files there "
+              "and restart, or ask ungrounded questions)")
+
+    # Component #4: chat loop
+    async def page(request: web.Request) -> web.Response:
+        return web.Response(text=PAGE, content_type="text/html")
+
+    async def ask(request: web.Request) -> web.StreamResponse:
+        question = (await request.text()).strip()
+        docs = index.similarity_search(question, k=4)
+        context = "\n\n".join(cap_context([d.text for d in docs], 1500))
+        resp = web.StreamResponse()
+        await resp.prepare(request)
+        for chunk in llm.stream(PROMPT.format(context=context,
+                                              question=question),
+                                max_tokens=256):
+            await resp.write(chunk.encode())
+        await resp.write_eof()
+        return resp
+
+    app = web.Application()
+    app.router.add_get("/", page)
+    app.router.add_post("/ask", ask)
+    print(f"open http://localhost:{args.port}")
+    web.run_app(app, port=args.port)
+
+
+if __name__ == "__main__":
+    main()

@@ -29,8 +29,15 @@ idiomatic JAX/XLA/Pallas/pjit:
 - ``frontend/``  Web chat + knowledge-base UI (reference: frontend/).
 - ``obs/``       OpenTelemetry tracing + first-party TTFT/TPS metrics
                  (reference: common/tracing.py, tools/observability/).
-- ``tools/``     Evaluation (synthetic QA, RAGAS-style metrics, LLM judge)
-                 and streaming ingest.
+- ``tools/``     Evaluation: synthetic QA, RAGAS-style metrics, retrieval
+                 nDCG, LLM judge (reference: tools/evaluation/).
+- ``ingest/``    Streaming ingest: fs/RSS/Kafka sources -> chunk ->
+                 batched embed -> vector store
+                 (reference: experimental/streaming_ingest_rag/).
+- ``integrations/`` LangChain + LlamaIndex connector classes
+                 (reference: integrations/langchain/).
+- ``deploy/``    HelmPipeline operator, chart renderer, compose profiles
+                 (reference: deploy/).
 """
 
 __version__ = "0.1.0"

@@ -26,9 +26,25 @@ logger = get_logger(__name__)
 _STATIC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "static")
 
 
-def create_app(client: ChatClient) -> web.Application:
+def _speech_from_env():
+    """Build ASR/TTS clients when Riva is configured (RIVA_API_URI), else
+    (None, None) — the converse page hides its mic/speaker controls."""
+    server = os.environ.get("RIVA_API_URI", "")
+    if not server:
+        return None, None
+    try:
+        from .speech import ASRClient, TTSClient
+        return ASRClient(server), TTSClient(server)
+    except Exception as exc:  # noqa: BLE001 — degrade, don't crash the UI
+        logger.warning("speech disabled: %s", exc)
+        return None, None
+
+
+def create_app(client: ChatClient, asr=None, tts=None) -> web.Application:
     app = web.Application(client_max_size=100 * 1024 ** 2)
     uploads: list[dict] = []  # kb page file table (reference: kb.py)
+    if asr is None and tts is None:
+        asr, tts = _speech_from_env()
 
     async def index(request: web.Request) -> web.Response:
         raise web.HTTPFound("/content/converse")
@@ -120,6 +136,40 @@ def create_app(client: ChatClient) -> web.Application:
     async def health(request: web.Request) -> web.Response:
         return web.json_response({"status": "ok"})
 
+    # Speech: mic transcription + TTS of answers, the converse-page
+    # wiring of the reference (reference: frontend/frontend/pages/
+    # converse.py:65 builds mic + audio output into the chat page).
+    async def api_speech_config(request: web.Request) -> web.Response:
+        return web.json_response({"asr": asr is not None,
+                                  "tts": tts is not None})
+
+    async def api_transcribe(request: web.Request) -> web.Response:
+        if asr is None:
+            raise web.HTTPNotImplemented(text="speech not configured "
+                                              "(set RIVA_API_URI)")
+        audio = await request.read()   # 16 kHz mono 16-bit PCM WAV
+        loop = asyncio.get_running_loop()
+        try:
+            text = await loop.run_in_executor(
+                None, lambda: asr.transcribe(audio))
+        except Exception as exc:  # noqa: BLE001 — surface to the UI
+            raise web.HTTPBadGateway(text=f"asr failed: {exc}") from exc
+        return web.json_response({"text": text})
+
+    async def api_tts(request: web.Request) -> web.Response:
+        if tts is None:
+            raise web.HTTPNotImplemented(text="speech not configured "
+                                              "(set RIVA_API_URI)")
+        body = await request.json()
+        text = str(body.get("text", ""))[:4000]
+        loop = asyncio.get_running_loop()
+        try:
+            audio = await loop.run_in_executor(
+                None, lambda: tts.synthesize(text))
+        except Exception as exc:  # noqa: BLE001
+            raise web.HTTPBadGateway(text=f"tts failed: {exc}") from exc
+        return web.Response(body=audio, content_type="audio/wav")
+
     app.router.add_get("/", index)
     app.router.add_get("/content/converse", converse)
     app.router.add_get("/content/kb", kb)
@@ -128,6 +178,9 @@ def create_app(client: ChatClient) -> web.Application:
     app.router.add_post("/api/search", api_search)
     app.router.add_post("/api/upload", api_upload)
     app.router.add_get("/api/kb", api_kb)
+    app.router.add_get("/api/speech/config", api_speech_config)
+    app.router.add_post("/api/speech/transcribe", api_transcribe)
+    app.router.add_post("/api/speech/tts", api_tts)
     app.router.add_get("/health", health)
     return app
 

@@ -56,6 +56,22 @@ class ASRClient:
                 if result.alternatives:
                     yield result.alternatives[0].transcript
 
+    def transcribe(self, audio: bytes) -> str:
+        """Offline recognition of a complete recording (the converse
+        page's mic posts one 16 kHz LINEAR_PCM WAV): all final segments
+        concatenated — a multi-utterance recording keeps every sentence,
+        not just the last recognizer yield."""
+        riva = self._riva
+        config = riva.RecognitionConfig(
+            encoding=riva.AudioEncoding.LINEAR_PCM,
+            language_code=self.language_code,
+            sample_rate_hertz=self.sample_rate_hz,
+            max_alternatives=1, enable_automatic_punctuation=True)
+        response = self._service.offline_recognize(audio, config)
+        return " ".join(
+            r.alternatives[0].transcript.strip()
+            for r in response.results if r.alternatives).strip()
+
 
 class TTSClient:
     """Text-to-speech (reference: tts_utils.py ``text_to_speech``)."""

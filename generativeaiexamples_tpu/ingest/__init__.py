@@ -1,0 +1,22 @@
+"""Streaming ingest: continuous sources -> chunks -> embeddings -> store.
+
+The TPU-native replacement for the reference's Morpheus VDB-upload
+pipeline (reference: experimental/streaming_ingest_rag/pipeline.py:60-102
+— RSS/filesystem/Kafka source pipes -> content extraction -> tokenize ->
+Triton embedding -> WriteToVectorDBStage, with MonitorStage throughput
+counters between stages). Morpheus is a GPU SIMD pipeline framework;
+here the same shape is an asyncio pipeline — stages connected by bounded
+queues (natural backpressure), the embed stage batching documents into
+the jit-compiled encoder, per-stage counters in the metrics registry.
+
+  sources.py   FilesystemSource (glob + poll watch), RSSSource
+               (stdlib XML parsing), KafkaSource (gated on a client lib)
+  pipeline.py  stage runner + batching + stats
+  __main__.py  CLI: python -m generativeaiexamples_tpu.ingest ...
+"""
+
+from .pipeline import IngestPipeline, PipelineStats
+from .sources import FilesystemSource, KafkaSource, RSSSource, SourceItem
+
+__all__ = ["IngestPipeline", "PipelineStats", "FilesystemSource",
+           "RSSSource", "KafkaSource", "SourceItem"]

@@ -1,0 +1,79 @@
+"""CLI: ``python -m generativeaiexamples_tpu.ingest`` — streaming ingest.
+
+The script form of the reference's ``run.py`` CLI over its Morpheus
+pipeline (reference: experimental/streaming_ingest_rag/run.py +
+vdb_utils.py config merge). Sources: --files GLOB (optionally --watch),
+--rss URL, --kafka TOPIC. The destination index persists with --save-dir
+so a chain server can load it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m generativeaiexamples_tpu.ingest")
+    parser.add_argument("--files", action="append", default=[],
+                        help="glob pattern (repeatable)")
+    parser.add_argument("--rss", action="append", default=[],
+                        help="feed URL (repeatable)")
+    parser.add_argument("--kafka", default="",
+                        help="topic (requires --kafka-servers)")
+    parser.add_argument("--kafka-servers", default="localhost:9092")
+    parser.add_argument("--watch", action="store_true",
+                        help="keep polling sources for new content")
+    parser.add_argument("--poll-interval", type=float, default=5.0)
+    parser.add_argument("--embedder", default="hash",
+                        choices=["hash", "tpu-jax"])
+    parser.add_argument("--embedding-dim", type=int, default=256)
+    parser.add_argument("--store", default="exact")
+    parser.add_argument("--chunk-size", type=int, default=510)
+    parser.add_argument("--chunk-overlap", type=int, default=200)
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--max-items", type=int, default=None)
+    parser.add_argument("--save-dir", default="")
+    args = parser.parse_args(argv)
+
+    from ..embed.encoder import get_embedder
+    from ..retrieval.docstore import DocumentIndex
+    from .pipeline import IngestPipeline
+    from .sources import FilesystemSource, KafkaSource, RSSSource
+
+    sources = []
+    if args.files:
+        sources.append(FilesystemSource(args.files, watch=args.watch,
+                                        poll_interval=args.poll_interval))
+    if args.rss:
+        sources.append(RSSSource(args.rss, watch=args.watch,
+                                 poll_interval=args.poll_interval))
+    if args.kafka:
+        sources.append(KafkaSource(args.kafka_servers, args.kafka))
+    if not sources:
+        parser.error("at least one of --files/--rss/--kafka is required")
+
+    async def merged():
+        for src in sources:
+            async for item in src:
+                yield item
+
+    embedder = get_embedder(args.embedder, "e5-large-v2",
+                            dim=args.embedding_dim)
+    index = DocumentIndex(embedder, store_name=args.store)
+    pipe = IngestPipeline(merged(), index, chunk_size=args.chunk_size,
+                          chunk_overlap=args.chunk_overlap,
+                          batch_size=args.batch_size,
+                          max_items=args.max_items)
+    stats = pipe.run_sync()
+    if args.save_dir:
+        index.save(args.save_dir)
+    json.dump(stats.snapshot(), sys.stdout)
+    print()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -278,7 +278,7 @@ def load_checkpoint(path: str, cfg: LlamaConfig,
     fmt = detect_checkpoint_format(path)
     if fmt in ("gptq", "awq"):
         from .import_quantized import load_quantized_checkpoint
-        return load_quantized_checkpoint(path, cfg, dtype)
+        return load_quantized_checkpoint(path, cfg, dtype, fmt=fmt)
     if fmt == "nemo":
         from .import_nemo import load_nemo_checkpoint
         return load_nemo_checkpoint(path, cfg, dtype)

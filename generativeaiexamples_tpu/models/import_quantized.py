@@ -162,11 +162,13 @@ def _stack_leaves(per_layer: list[dict[str, np.ndarray]],
 
 
 def load_quantized_checkpoint(path: str, cfg: LlamaConfig,
-                              dtype: jnp.dtype = jnp.bfloat16) -> Params:
+                              dtype: jnp.dtype = jnp.bfloat16,
+                              fmt: str = "") -> Params:
     """Load a GPTQ or AWQ checkpoint into a stacked llama param tree with
     group-wise int4 leaves. Plain tensors (embeddings, norms, lm_head)
-    load at ``dtype``."""
-    fmt = sniff_quantized_format(path)
+    load at ``dtype``. ``fmt`` skips re-sniffing when the caller already
+    detected it."""
+    fmt = fmt or sniff_quantized_format(path)
     if not fmt:
         raise ModelLoadError(f"{path}: neither GPTQ (.qweight) nor AWQ "
                              "(weight_quantizer._amax) tensors found")

@@ -90,8 +90,9 @@ def _parse_trainer_ids(buf: bytes) -> dict[str, int]:
     out: dict[str, int] = {}
     for field, wire, value in _fields(buf):
         if field in names and wire == 0:
-            # ids are int32; pad defaults to -1 (absent)
-            v = int(value)
+            # ids are int32, but protobuf serializes negatives (pad_id=-1)
+            # as 64-bit varints: mask to 32 bits before sign-adjusting
+            v = int(value) & 0xFFFFFFFF
             if v >= 1 << 31:
                 v -= 1 << 32
             out[names[field]] = v

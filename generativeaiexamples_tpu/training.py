@@ -28,21 +28,32 @@ def cross_entropy_loss(logits: jax.Array, targets: jax.Array,
     return jnp.sum(nll * maskf) / jnp.maximum(jnp.sum(maskf), 1.0)
 
 
-def make_train_step(cfg: LlamaConfig, optimizer: optax.GradientTransformation):
+def make_train_step(cfg: LlamaConfig, optimizer: optax.GradientTransformation,
+                    mesh=None, n_microbatches: int = 2):
     """Build a (params, opt_state, batch) -> (params, opt_state, loss) step.
 
     ``batch`` = {"tokens": (B,S), "targets": (B,S), "mask": (B,S)}.
     jit it with shardings from ``parallel.llama_param_specs`` to train over
     a mesh; XLA inserts the gradient all-reduces over dp and the TP
-    collectives over tp.
+    collectives over tp. When ``mesh`` has pp > 1 the forward runs the
+    GPipe microbatch schedule (parallel/pipeline.py) — layers stream
+    stage-to-stage over ``ppermute`` and gradients flow back through the
+    schedule.
     """
-
-    def loss_fn(params: llama.Params, batch: dict[str, jax.Array]) -> jax.Array:
-        B, S = batch["tokens"].shape
-        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
-        logits, _ = llama.apply(params, cfg, batch["tokens"], positions,
-                                kv_valid_len=jnp.sum(batch["mask"], axis=-1))
-        return cross_entropy_loss(logits, batch["targets"], batch["mask"])
+    if mesh is not None and dict(mesh.shape).get("pp", 1) > 1:
+        from .parallel.pipeline import pipeline_loss_fn
+        loss_fn = pipeline_loss_fn(mesh, cfg, n_microbatches=n_microbatches)
+    else:
+        def loss_fn(params: llama.Params,
+                    batch: dict[str, jax.Array]) -> jax.Array:
+            B, S = batch["tokens"].shape
+            positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32),
+                                         (B, S))
+            logits, _ = llama.apply(
+                params, cfg, batch["tokens"], positions,
+                kv_valid_len=jnp.sum(batch["mask"], axis=-1))
+            return cross_entropy_loss(logits, batch["targets"],
+                                      batch["mask"])
 
     def train_step(params: llama.Params, opt_state: Any,
                    batch: dict[str, jax.Array]):

@@ -133,3 +133,54 @@ def test_speech_gated():
         ASRClient()
     with pytest.raises(ConfigError, match="riva"):
         TTSClient()
+
+
+# ------------------------------------------------------------------ speech
+
+class FakeASR:
+    def transcribe(self, audio):
+        return f"transcript of {len(audio)} bytes"
+
+
+class FakeTTS:
+    def synthesize(self, text):
+        return b"RIFFfake-wav:" + text.encode()[:16]
+
+
+def test_speech_routes_with_clients():
+    """Mic + TTS wiring of the converse page (reference: converse.py:65)."""
+    from generativeaiexamples_tpu.frontend.chat_client import ChatClient
+    client = ChatClient("http://127.0.0.1:9")   # never called by these routes
+    app = frontend_app(client, asr=FakeASR(), tts=FakeTTS())
+    base, _ = _serve(app)
+
+    cfg = requests.get(f"{base}/api/speech/config", timeout=10).json()
+    assert cfg == {"asr": True, "tts": True}
+
+    r = requests.post(f"{base}/api/speech/transcribe", data=b"audio-bytes",
+                      timeout=10)
+    assert r.ok and r.json()["text"] == "transcript of 11 bytes"
+
+    r = requests.post(f"{base}/api/speech/tts", json={"text": "hello"},
+                      timeout=10)
+    assert r.ok
+    assert r.headers["Content-Type"].startswith("audio/")
+    assert r.content.startswith(b"RIFFfake-wav:hello")
+
+    page = requests.get(f"{base}/content/converse", timeout=10).text
+    assert 'id="mic"' in page and 'id="usetts"' in page
+    assert "/api/speech/transcribe" in page
+
+
+def test_speech_routes_degrade_without_riva():
+    from generativeaiexamples_tpu.frontend.chat_client import ChatClient
+    client = ChatClient("http://127.0.0.1:9")
+    app = frontend_app(client)   # no RIVA_API_URI -> disabled
+    base, _ = _serve(app)
+    cfg = requests.get(f"{base}/api/speech/config", timeout=10).json()
+    assert cfg == {"asr": False, "tts": False}
+    r = requests.post(f"{base}/api/speech/transcribe", data=b"x", timeout=10)
+    assert r.status_code == 501
+    r = requests.post(f"{base}/api/speech/tts", json={"text": "x"},
+                      timeout=10)
+    assert r.status_code == 501

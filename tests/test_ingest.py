@@ -1,0 +1,192 @@
+"""Streaming-ingest pipeline tests (reference behavior:
+experimental/streaming_ingest_rag — sources -> extract -> chunk ->
+batched embed -> vector store, with throughput counters)."""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from generativeaiexamples_tpu.embed.encoder import get_embedder
+from generativeaiexamples_tpu.ingest import (FilesystemSource,
+                                             IngestPipeline, RSSSource,
+                                             SourceItem)
+from generativeaiexamples_tpu.ingest.sources import KafkaSource
+from generativeaiexamples_tpu.retrieval.docstore import DocumentIndex
+
+
+def _index():
+    return DocumentIndex(get_embedder("hash", "hash", dim=64),
+                         store_name="exact")
+
+
+def _run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+# ---------------------------------------------------------------- sources
+
+def test_filesystem_source_oneshot(tmp_path):
+    for i in range(3):
+        (tmp_path / f"doc{i}.txt").write_text(f"document number {i}")
+    (tmp_path / "skipme.bin.unrelated").mkdir()
+
+    async def collect():
+        src = FilesystemSource(str(tmp_path / "*.txt"))
+        return [item async for item in src]
+
+    items = _run(collect())
+    assert len(items) == 3
+    assert all(item.path.endswith(".txt") for item in items)
+    assert items[0].metadata["kind"] == "file"
+
+
+def test_filesystem_source_watch_picks_up_new_files(tmp_path):
+    (tmp_path / "a.txt").write_text("first")
+
+    async def scenario():
+        src = FilesystemSource(str(tmp_path / "*.txt"), watch=True,
+                               poll_interval=0.05)
+        seen = []
+        async for item in src:
+            seen.append(os.path.basename(item.path))
+            if len(seen) == 1:
+                (tmp_path / "b.txt").write_text("second")
+            if len(seen) >= 2:
+                break
+        return seen
+
+    seen = _run(asyncio.wait_for(scenario(), timeout=10))
+    assert seen == ["a.txt", "b.txt"]
+
+
+RSS_XML = """<?xml version="1.0"?>
+<rss version="2.0"><channel><title>Feed</title>
+<item><guid>g1</guid><title>TPU news</title>
+<description>&lt;p&gt;The &lt;b&gt;MXU&lt;/b&gt; is big.&lt;/p&gt;</description></item>
+<item><guid>g2</guid><title>Second</title>
+<description>Paged KV caching works.</description></item>
+</channel></rss>"""
+
+
+def test_rss_source_parses_and_dedups():
+    fetches = []
+
+    def fake_fetch(url):
+        fetches.append(url)
+        return RSS_XML
+
+    async def collect(src):
+        return [item async for item in src]
+
+    src = RSSSource("http://example.test/feed", fetch=fake_fetch)
+    items = _run(collect(src))
+    assert len(items) == 2
+    assert items[0].metadata["title"] == "TPU news"
+    assert "MXU" in items[0].content and "<b>" not in items[0].content
+    # same source object refetching yields nothing new (dedup by guid)
+    again = _run(collect(src))
+    assert again == []
+
+
+def test_kafka_source_with_fake_consumer():
+    class Rec:
+        def __init__(self, value, offset):
+            self.value, self.offset = value, offset
+
+    class FakeConsumer:
+        _drain_once = True
+
+        def __init__(self):
+            self.polls = [
+                {"tp": [Rec(json.dumps({"content": "kafka doc"}).encode(),
+                            0),
+                        Rec(b"plain text", 1)]},
+                {},
+            ]
+
+        def poll(self, timeout_ms=0):
+            return self.polls.pop(0) if self.polls else {}
+
+    async def collect():
+        src = KafkaSource("unused:9092", "topic", consumer=FakeConsumer())
+        return [item async for item in src]
+
+    items = _run(collect())
+    assert [i.content for i in items] == ["kafka doc", "plain text"]
+    assert items[0].source_id == "topic@0"
+
+
+def test_kafka_source_without_client_errors():
+    with pytest.raises(ImportError):
+        KafkaSource("localhost:9092", "topic")
+
+
+# --------------------------------------------------------------- pipeline
+
+def test_pipeline_end_to_end(tmp_path):
+    for i in range(4):
+        (tmp_path / f"d{i}.txt").write_text(
+            f"document {i} about paged KV caching " * 30)
+    index = _index()
+    pipe = IngestPipeline(
+        FilesystemSource(str(tmp_path / "*.txt")), index,
+        chunk_size=40, chunk_overlap=10, batch_size=8, linger_sec=0.2)
+    stats = pipe.run_sync()
+    assert stats.items_in == 4
+    assert stats.documents_extracted == 4
+    assert stats.chunks > 4                    # chunking split them
+    assert stats.chunks_stored == stats.chunks
+    assert stats.batches >= 1
+    assert len(index) == stats.chunks
+    hits = index.similarity_search("paged KV caching", k=2)
+    assert hits and "paged KV" in hits[0].text
+    snap = stats.snapshot()
+    assert snap["chunks_per_sec"] > 0
+
+
+def test_pipeline_skips_bad_documents(tmp_path):
+    good = tmp_path / "good.txt"
+    good.write_text("valid document")
+
+    async def source():
+        yield SourceItem(path=str(tmp_path / "missing.txt"),
+                         source_id="missing")
+        yield SourceItem(path=str(good), source_id="good")
+
+    index = _index()
+    pipe = IngestPipeline(source(), index, chunk_size=50, chunk_overlap=0,
+                          linger_sec=0.1)
+    stats = pipe.run_sync()
+    assert stats.errors == 1
+    assert stats.documents_extracted == 1
+    assert len(index) >= 1
+
+
+def test_pipeline_max_items_bounds_continuous_sources(tmp_path):
+    (tmp_path / "a.txt").write_text("doc a")
+    (tmp_path / "b.txt").write_text("doc b")
+    src = FilesystemSource(str(tmp_path / "*.txt"), watch=True,
+                           poll_interval=0.05)
+    pipe = IngestPipeline(src, _index(), max_items=2, linger_sec=0.1)
+    stats = _run(asyncio.wait_for(pipe.run(), timeout=10))
+    assert stats.items_in == 2
+
+
+def test_ingest_cli(tmp_path):
+    (tmp_path / "doc.txt").write_text("The interconnect carries "
+                                      "collectives between chips. " * 20)
+    out_dir = tmp_path / "saved"
+    proc = subprocess.run(
+        [sys.executable, "-m", "generativeaiexamples_tpu.ingest",
+         "--files", str(tmp_path / "*.txt"), "--chunk-size", "40",
+         "--chunk-overlap", "10", "--save-dir", str(out_dir)],
+        capture_output=True, text=True, timeout=240,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    stats = json.loads(proc.stdout)
+    assert stats["chunks_stored"] > 0
+    assert (out_dir / "docs.jsonl").exists()
