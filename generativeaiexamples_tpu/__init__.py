@@ -36,6 +36,11 @@ idiomatic JAX/XLA/Pallas/pjit:
                  (reference: experimental/streaming_ingest_rag/).
 - ``integrations/`` LangChain + LlamaIndex connector classes
                  (reference: integrations/langchain/).
+- ``assistant/`` Multimodal assistant: PPTX/DOCX parsing, conversation
+                 memory, fact-check guardrail, feedback capture
+                 (reference: experimental/multimodal_assistant/).
+- ``lora.py``    LoRA fine-tuning over any mesh, QLoRA over quantized
+                 bases (reference: models/Gemma/lora.ipynb recipes).
 - ``deploy/``    HelmPipeline operator, chart renderer, compose profiles
                  (reference: deploy/).
 """

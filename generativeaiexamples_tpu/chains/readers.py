@@ -69,11 +69,22 @@ def read_pdf(path: str) -> str:
     return re.sub(r"\s+", " ", text).strip()
 
 
+def _read_pptx(path: str) -> str:
+    from ..assistant.parsers import read_pptx
+    return read_pptx(path)
+
+
+def _read_docx(path: str) -> str:
+    from ..assistant.parsers import read_docx
+    return read_docx(path)
+
+
 _READERS = {
     ".txt": read_text, ".md": read_text, ".rst": read_text, ".py": read_text,
     ".json": read_text, ".csv": read_text, ".yaml": read_text, ".yml": read_text,
     ".html": read_html, ".htm": read_html,
     ".pdf": read_pdf,
+    ".pptx": _read_pptx, ".docx": _read_docx,
 }
 
 

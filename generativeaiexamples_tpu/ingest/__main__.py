@@ -56,9 +56,31 @@ def main(argv=None) -> int:
         parser.error("at least one of --files/--rss/--kafka is required")
 
     async def merged():
-        for src in sources:
-            async for item in src:
+        # Pump sources concurrently: sequential chaining would let a
+        # --watch source's infinite poll loop starve every later source.
+        import asyncio
+        q: asyncio.Queue = asyncio.Queue(maxsize=64)
+        stop = object()
+
+        async def pump(src):
+            try:
+                async for item in src:
+                    await q.put(item)
+            finally:
+                await q.put(stop)
+
+        tasks = [asyncio.ensure_future(pump(s)) for s in sources]
+        done_sources = 0
+        try:
+            while done_sources < len(sources):
+                item = await q.get()
+                if item is stop:
+                    done_sources += 1
+                    continue
                 yield item
+        finally:
+            for t in tasks:
+                t.cancel()
 
     embedder = get_embedder(args.embedder, "e5-large-v2",
                             dim=args.embedding_dim)

@@ -104,7 +104,8 @@ class RSSSource:
 
     def _parse(self, xml_text: str, url: str) -> list[SourceItem]:
         root = ET.fromstring(xml_text)
-        ns = {"atom": "http://www.w3.org/2005/Atom"}
+        ns = {"atom": "http://www.w3.org/2005/Atom",
+              "content": "http://purl.org/rss/1.0/modules/content/"}
         items = []
         # RSS 2.0 <item> or Atom <entry>
         entries = root.findall(".//item") or root.findall(".//atom:entry",
@@ -112,7 +113,10 @@ class RSSSource:
         for entry in entries:
             def text_of(*tags: str) -> str:
                 for tag in tags:
-                    node = entry.find(tag, ns)
+                    try:
+                        node = entry.find(tag, ns)
+                    except SyntaxError:  # unmapped prefix: skip the tag
+                        continue
                     if node is not None and (node.text or "").strip():
                         return node.text.strip()
                 return ""

@@ -68,6 +68,33 @@ def fast_hash_dir(path: str, workers: int = 8) -> str:
     return top.hexdigest()
 
 
+def resolve_azureml_model_dir(model_path: str = "") -> str:
+    """AzureML managed-endpoint accommodation: when AZUREML_MODEL_DIR is
+    set and no explicit --model-path was given, the checkpoint lives one
+    level under it ($AZUREML_MODEL_DIR/<model_name>) — resolve to that
+    directory (reference: model_server/__init__.py:36-69 ``_azureml``,
+    which symlinks the same layout into /model; no symlinks needed here,
+    the importers take the path directly)."""
+    if model_path:
+        return model_path
+    aml = os.environ.get("AZUREML_MODEL_DIR", "")
+    if not aml:
+        return model_path
+    aml = os.path.abspath(aml)
+    # MLflow-registered models put files (MLmodel, conda.yaml, .amlignore)
+    # next to the model folder — only a directory can be the checkpoint
+    entries = [n for n in sorted(os.listdir(aml))
+               if os.path.isdir(os.path.join(aml, n))
+               and not n.startswith(".")] if os.path.isdir(aml) else []
+    if not entries:
+        raise ConfigError(
+            f"AZUREML_MODEL_DIR={aml} contains no model directory: "
+            "AzureML folder structure not recognized")
+    resolved = os.path.join(aml, entries[0])
+    logger.info("AzureML detected: model dir %s", resolved)
+    return resolved
+
+
 def resolve_topology(world_size: int = 0, tp: int = 0, pp: int = 1,
                      available: Optional[int] = None) -> tuple[int, int, int]:
     """(world, tp, pp) with the reference's defaulting rules
@@ -141,6 +168,7 @@ def build_services(model_type: str = "dev", model_name: str = "",
             f"unknown model type {model_type!r}; known: {MODEL_TYPES}")
     model_name = model_name or _TYPE_DEFAULT_NAME[model_type]
     cfg = get_model_config(model_name)
+    model_path = resolve_azureml_model_dir(model_path)
 
     world, tp, pp = resolve_topology(world_size, tp, pp)
     mesh = make_mesh(MeshPlan(tp=tp, pp=pp), jax.devices()[:world]) \

@@ -1,0 +1,221 @@
+"""Multimodal-assistant tests (reference behavior:
+experimental/multimodal_assistant/ — pptx/docx parsing with slide
+provenance, conversation memory, fact-check guardrail, feedback)."""
+
+import zipfile
+
+import pytest
+
+from generativeaiexamples_tpu.assistant import (ConversationMemory,
+                                                FeedbackStore,
+                                                MultimodalAssistant,
+                                                fact_check, read_docx,
+                                                read_pptx)
+from generativeaiexamples_tpu.assistant.parsers import (extract_images,
+                                                        parse_pptx)
+from generativeaiexamples_tpu.chains.llm import LLM
+from generativeaiexamples_tpu.chains.readers import read_document
+
+_A = 'xmlns:a="http://schemas.openxmlformats.org/drawingml/2006/main"'
+_P = 'xmlns:p="http://schemas.openxmlformats.org/presentationml/2006/main"'
+_R = ('xmlns:r="http://schemas.openxmlformats.org/officeDocument/2006/'
+      'relationships"')
+
+
+def _slide_xml(*texts):
+    runs = "".join(f"<a:t>{t}</a:t>" for t in texts)
+    return f'<p:sld {_P} {_A}>{runs}</p:sld>'
+
+
+_REL_NS = ('xmlns="http://schemas.openxmlformats.org/package/2006/'
+           'relationships"')
+_T_IMAGE = ("http://schemas.openxmlformats.org/officeDocument/2006/"
+            "relationships/image")
+_T_NOTES = ("http://schemas.openxmlformats.org/officeDocument/2006/"
+            "relationships/notesSlide")
+_T_VIDEO = ("http://schemas.openxmlformats.org/officeDocument/2006/"
+            "relationships/video")
+
+
+def make_pptx(path):
+    """Slide 1: image + a video (must not count as an image). Slide 2:
+    the deck's only speaker notes — stored as notesSlide1.xml (notes are
+    numbered by creation order, not slide order)."""
+    with zipfile.ZipFile(path, "w") as z:
+        z.writestr("ppt/slides/slide1.xml",
+                   _slide_xml("TPU Architecture", "The MXU does matmuls."))
+        z.writestr("ppt/slides/slide2.xml",
+                   _slide_xml("Paged KV", "Pages are 128 tokens."))
+        z.writestr("ppt/notesSlides/notesSlide1.xml",
+                   _slide_xml("Mention the systolic array."))
+        z.writestr(
+            "ppt/slides/_rels/slide1.xml.rels",
+            f'<Relationships {_REL_NS}>'
+            f'<Relationship Id="rId2" Type="{_T_IMAGE}" '
+            'Target="../media/image1.png"/>'
+            f'<Relationship Id="rId3" Type="{_T_VIDEO}" '
+            'Target="../media/movie1.mp4"/></Relationships>')
+        z.writestr(
+            "ppt/slides/_rels/slide2.xml.rels",
+            f'<Relationships {_REL_NS}>'
+            f'<Relationship Id="rId2" Type="{_T_NOTES}" '
+            'Target="../notesSlides/notesSlide1.xml"/></Relationships>')
+        z.writestr("ppt/media/image1.png", b"\x89PNGfake")
+        z.writestr("ppt/media/movie1.mp4", b"fakemp4")
+    return path
+
+
+def make_docx(path):
+    w = ('xmlns:w="http://schemas.openxmlformats.org/wordprocessingml/'
+         '2006/main"')
+    body = (f'<w:document {w}><w:body>'
+            '<w:p><w:r><w:t>First paragraph about ICI.</w:t></w:r></w:p>'
+            '<w:p><w:r><w:t>Second about </w:t></w:r>'
+            '<w:r><w:t>collectives.</w:t></w:r></w:p>'
+            '</w:body></w:document>')
+    with zipfile.ZipFile(path, "w") as z:
+        z.writestr("word/document.xml", body)
+    return path
+
+
+class ScriptedLLM(LLM):
+    def __init__(self, responses):
+        self.responses = list(responses)
+        self.prompts = []
+
+    def stream(self, prompt, max_tokens=256, stop=None, temperature=1.0,
+               top_k=1, top_p=0.0):
+        self.prompts.append(prompt)
+        idx = min(len(self.prompts) - 1, len(self.responses) - 1)
+        yield self.responses[idx]
+
+
+# ---------------------------------------------------------------- parsers
+
+def test_parse_pptx_slides_notes_images(tmp_path):
+    path = make_pptx(str(tmp_path / "deck.pptx"))
+    slides = parse_pptx(path)
+    assert [s.index for s in slides] == [1, 2]
+    assert "MXU" in slides[0].text
+    # notes pair through the slide's rels, not the notesSlide number:
+    # notesSlide1.xml belongs to SLIDE 2 here
+    assert slides[0].notes == ""
+    assert "systolic array" in slides[1].notes
+    # the embedded video is not an image
+    assert slides[0].images == ["image1.png"]
+    assert slides[1].images == []
+    flat = read_pptx(path)
+    assert "[slide 1]" in flat and "Paged KV" in flat
+    assert "image1.png" in flat and "movie1.mp4" not in flat
+
+
+def test_extract_images(tmp_path):
+    path = make_pptx(str(tmp_path / "deck.pptx"))
+    out = extract_images(path, str(tmp_path / "media"))
+    assert any(p.endswith("image1.png") for p in out)
+
+
+def test_read_docx_and_registry(tmp_path):
+    path = make_docx(str(tmp_path / "doc.docx"))
+    text = read_docx(path)
+    assert "First paragraph about ICI." in text
+    assert "Second about collectives." in text
+    # the generic reader registry resolves the new extensions too
+    assert read_document(path) == text
+    assert "MXU" in read_document(make_pptx(str(tmp_path / "d2.pptx")))
+
+
+# ----------------------------------------------------------------- memory
+
+def test_memory_bounds_and_renders():
+    mem = ConversationMemory(max_turns=2, max_chars=10_000)
+    mem.add("q1", "a1")
+    mem.add("q2", "a2")
+    mem.add("q3", "a3")
+    text = mem.render()
+    assert "q1" not in text and "q2" in text and "q3" in text
+    mem2 = ConversationMemory(max_turns=10, max_chars=40)
+    mem2.add("a" * 30, "b" * 30)
+    mem2.add("new question", "short")
+    assert "new question" in mem2.render()
+    assert "a" * 30 not in mem2.render()
+
+
+# -------------------------------------------------------------- guardrail
+
+def test_fact_check_verdicts():
+    yes = fact_check(ScriptedLLM(["VERDICT: TRUE All claims match."]),
+                     "ctx", "q", "resp")
+    assert yes.supported is True and "match" in yes.explanation
+    no = fact_check(ScriptedLLM(["VERDICT: FALSE Claim 2 is invented."]),
+                    "ctx", "q", "resp")
+    assert no.supported is False
+    shrug = fact_check(ScriptedLLM(["cannot say"]), "ctx", "q", "resp")
+    assert shrug.supported is None
+
+
+# --------------------------------------------------------------- feedback
+
+def test_feedback_roundtrip(tmp_path):
+    store = FeedbackStore(str(tmp_path / "fb.jsonl"))
+    store.record("q", "a", 4, comment="good", sources=["deck.pptx"])
+    store.record("q2", "a2", 1)
+    entries = store.load()
+    assert len(entries) == 2
+    assert entries[0]["rating"] == 4
+    assert entries[0]["sources"] == ["deck.pptx"]
+
+
+# -------------------------------------------------------------- assistant
+
+def _assistant(llm, tmp_path, check_facts=True):
+    from generativeaiexamples_tpu.utils.app_config import AppConfig
+    from generativeaiexamples_tpu.utils.configuration import from_dict
+    cfg = from_dict(AppConfig, {
+        "llm": {"model_engine": "echo"},
+        "embeddings": {"model_engine": "hash", "dimensions": 64},
+        "vector_store": {"name": "exact"},
+        "text_splitter": {"chunk_size": 60, "chunk_overlap": 10}})
+    return MultimodalAssistant(
+        llm=llm, config=cfg, check_facts=check_facts,
+        feedback_path=str(tmp_path / "fb.jsonl"))
+
+
+def test_assistant_pptx_rag_with_guardrail(tmp_path):
+    llm = ScriptedLLM(["The MXU does matmuls.",
+                       "VERDICT: TRUE Supported by slide 1."])
+    bot = _assistant(llm, tmp_path)
+    bot.ingest_docs(make_pptx(str(tmp_path / "deck.pptx")), "deck.pptx")
+    out = "".join(bot.rag_chain("What does the MXU do?", 64))
+    assert "The MXU does matmuls." in out
+    assert "[fact check: supported" in out
+    hits = bot.document_search("MXU", 4)
+    assert any("slide 1" in h["source"] for h in hits)
+    # memory carries the turn
+    assert len(bot.memory) == 1
+    llm.responses.append("follow-up answer")
+    "".join(bot.rag_chain("and the pages?", 32))
+    assert "Conversation so far:" in llm.prompts[-2]  # history in prompt
+
+
+def test_assistant_flags_unsupported_answers(tmp_path):
+    llm = ScriptedLLM(["Invented claim.",
+                       "VERDICT: FALSE Not in the documents."])
+    bot = _assistant(llm, tmp_path)
+    bot.ingest_docs(make_pptx(str(tmp_path / "deck.pptx")), "deck.pptx")
+    out = "".join(bot.rag_chain("question?", 64))
+    assert "[fact check: NOT fully supported" in out
+
+
+def test_assistant_feedback(tmp_path):
+    bot = _assistant(ScriptedLLM(["a"]), tmp_path, check_facts=False)
+    bot.record_feedback("q", "a", 5, "nice")
+    assert bot.feedback.load()[0]["rating"] == 5
+
+
+def test_assistant_served_by_chain_server(tmp_path):
+    """The assistant is a BaseExample: the standard chain server serves
+    it (the reference needs a whole separate Streamlit app)."""
+    from generativeaiexamples_tpu.chains.server import discover_example
+    cls = discover_example("generativeaiexamples_tpu.assistant.assistant")
+    assert cls is MultimodalAssistant

@@ -239,3 +239,28 @@ def test_health_and_metrics(served):
     assert health["status"] == "ok" and health["model"] == "llama-tiny"
     metrics = requests.get(f"{base}/metrics", timeout=10).text
     assert "serve_completion_requests_total" in metrics
+
+
+def test_azureml_model_dir_resolution(tmp_path, monkeypatch):
+    """AzureML managed endpoints mount the model one level under
+    AZUREML_MODEL_DIR (reference: model_server/__init__.py:36-69)."""
+    from generativeaiexamples_tpu.serving.model_server import (
+        resolve_azureml_model_dir)
+    from generativeaiexamples_tpu.utils.errors import ConfigError
+
+    # explicit path wins
+    assert resolve_azureml_model_dir("/explicit") == "/explicit"
+    # no env: passthrough
+    monkeypatch.delenv("AZUREML_MODEL_DIR", raising=False)
+    assert resolve_azureml_model_dir("") == ""
+    # env set: resolve one level down
+    (tmp_path / "llama-2-7b").mkdir()
+    monkeypatch.setenv("AZUREML_MODEL_DIR", str(tmp_path))
+    assert resolve_azureml_model_dir("") == str(tmp_path / "llama-2-7b")
+    # empty dir: loud failure
+    empty = tmp_path / "llama-2-7b" / "nothing"
+    empty.mkdir()
+    monkeypatch.setenv("AZUREML_MODEL_DIR", str(empty))
+    import pytest as _pytest
+    with _pytest.raises(ConfigError):
+        resolve_azureml_model_dir("")
