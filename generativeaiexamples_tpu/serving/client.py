@@ -130,3 +130,51 @@ class OpenAIClient:
             timeout=self.timeout)
         resp.raise_for_status()
         return [d["embedding"] for d in resp.json()["data"]]
+
+
+class JobsClient:
+    """Submit-then-poll client for the async job API — the client half of
+    the NVCF 202 contract the reference's cloud connector implements
+    (reference: integrations/langchain/llms/nv_aiplay.py:222-239
+    ``_wait``: re-GET the status URL while 202)."""
+
+    def __init__(self, server_url: str, timeout: float = 300.0,
+                 poll_interval: float = 0.25):
+        self.base = server_url.rstrip("/")
+        self.timeout = timeout
+        self.poll_interval = poll_interval
+
+    def submit(self, prompt: str, **sampling) -> dict:
+        import requests
+        resp = requests.post(f"{self.base}/v1/jobs",
+                             json={"prompt": prompt, **sampling},
+                             timeout=30)
+        if resp.status_code not in (200, 202):
+            resp.raise_for_status()
+        return resp.json()
+
+    def wait(self, job_id: str) -> dict:
+        import time as _time
+
+        import requests
+        deadline = _time.monotonic() + self.timeout
+        while True:
+            resp = requests.get(f"{self.base}/v1/jobs/{job_id}", timeout=30)
+            if resp.status_code == 200:
+                return resp.json()
+            if resp.status_code != 202:
+                resp.raise_for_status()
+            if _time.monotonic() > deadline:
+                raise TimeoutError(f"job {job_id} still running after "
+                                   f"{self.timeout}s")
+            _time.sleep(self.poll_interval)
+
+    def generate(self, prompt: str, **sampling) -> str:
+        job = self.submit(prompt, **sampling)
+        if job["status"] == "done":
+            return job["text"]
+        return self.wait(job["id"])["text"]
+
+    def cancel(self, job_id: str) -> None:
+        import requests
+        requests.delete(f"{self.base}/v1/jobs/{job_id}", timeout=30)

@@ -237,12 +237,52 @@ def create_server_app(engine, embed_service=None,
         return web.Response(text=obs_metrics.REGISTRY.render_prometheus(),
                             content_type="text/plain")
 
+    # On-demand device profiling (SURVEY §5: the jax.profiler endpoint on
+    # the serving engine — the role nsys would play on the reference's
+    # stack). POST /profiler/start {"dir": ...} -> trace capture begins;
+    # POST /profiler/stop -> trace written for TensorBoard/XProf.
+    profiler_state = {"dir": None}
+
+    async def profiler_start(request: web.Request) -> web.Response:
+        import jax
+        try:
+            body = await request.json()
+        except Exception:  # noqa: BLE001 — empty body is fine
+            body = {}
+        # No awaits between the conflict check and the claim: concurrent
+        # starts must 409, not race into a double start_trace.
+        if profiler_state["dir"]:
+            raise web.HTTPConflict(text="profiler already running")
+        trace_dir = body.get("dir") or os.path.join(
+            "/tmp", "generativeaiexamples_tpu", "profile")
+        profiler_state["dir"] = trace_dir
+        try:
+            os.makedirs(trace_dir, exist_ok=True)
+            jax.profiler.start_trace(trace_dir)
+        except Exception:
+            profiler_state["dir"] = None
+            raise
+        return web.json_response({"status": "tracing", "dir": trace_dir})
+
+    async def profiler_stop(request: web.Request) -> web.Response:
+        import jax
+        if not profiler_state["dir"]:
+            raise web.HTTPConflict(text="profiler not running")
+        jax.profiler.stop_trace()
+        trace_dir, profiler_state["dir"] = profiler_state["dir"], None
+        return web.json_response({"status": "written", "dir": trace_dir})
+
     app.router.add_get("/health", health)
     app.router.add_get("/metrics", metrics_endpoint)
+    app.router.add_post("/profiler/start", profiler_start)
+    app.router.add_post("/profiler/stop", profiler_stop)
     add_openai_routes(app, engine, model_name, embed_service=embed_service,
                       max_output=engine.cfg.max_output_length)
     add_triton_routes(app, engine, model_name,
                       max_output=engine.cfg.max_output_length)
+    from .jobs_api import add_jobs_routes
+    add_jobs_routes(app, engine, model_name,
+                    max_output=engine.cfg.max_output_length)
     return app
 
 

@@ -259,3 +259,43 @@ def test_engine_restarts_after_stop():
         second = eng.generate_text("hi", SamplingParams(max_tokens=3, top_k=1,
                                                         ignore_eos=True))
     assert first == second
+
+
+def test_engine_reset_recovers(tiny_engine_factory=None):
+    """reset() abandons the loop, fails live requests, rebuilds device
+    state, and serving works again (VERDICT r2 weak #10)."""
+    import jax
+    import jax.numpy as jnp
+
+    from generativeaiexamples_tpu.engine import (Engine, EngineConfig,
+                                                 SamplingParams)
+    from generativeaiexamples_tpu.models import llama as _llama
+    from generativeaiexamples_tpu.models.configs import LLAMA_TINY
+    from generativeaiexamples_tpu.models.tokenizer import ByteTokenizer
+    from generativeaiexamples_tpu.utils.errors import EngineError
+
+    params = _llama.init_params(LLAMA_TINY, jax.random.key(0), jnp.float32)
+    cfg = EngineConfig(max_slots=2, max_input_length=64,
+                       max_output_length=32, prefill_buckets=(32, 64),
+                       dtype="float32", page_size=16, kv_pool_tokens=None,
+                       steps_per_round=4, dispatch_depth=1)
+    eng = Engine(params, LLAMA_TINY, ByteTokenizer(), cfg)
+    eng.start()
+    assert eng.generate_text("warm", SamplingParams(
+        max_tokens=4, top_k=1, ignore_eos=True))
+
+    # a request in flight when reset() lands gets failed, not hung
+    stream = eng.submit(eng.tokenizer.encode("pending request"),
+                        SamplingParams(max_tokens=8, top_k=1,
+                                       ignore_eos=True))
+    eng.reset()
+    with pytest.raises(EngineError):
+        stream.text()
+
+    # the engine is fully serviceable again after reset
+    eng.start()
+    out = eng.generate_text("after reset", SamplingParams(
+        max_tokens=4, top_k=1, ignore_eos=True))
+    assert out is not None
+    assert eng._fatal is None
+    eng.stop()

@@ -264,3 +264,138 @@ def test_azureml_model_dir_resolution(tmp_path, monkeypatch):
     import pytest as _pytest
     with _pytest.raises(ConfigError):
         resolve_azureml_model_dir("")
+
+
+def test_profiler_endpoints(tmp_path):
+    """On-demand jax.profiler trace capture through the serving API
+    (SURVEY §5)."""
+    import glob as _glob
+    import threading as _threading
+
+    import jax as _jax
+    import jax.numpy as _jnp
+    import requests as _requests
+    from aiohttp import web as _web
+
+    from generativeaiexamples_tpu.engine import Engine, EngineConfig
+    from generativeaiexamples_tpu.models import llama as _llama
+    from generativeaiexamples_tpu.models.configs import LLAMA_TINY
+    from generativeaiexamples_tpu.models.tokenizer import ByteTokenizer
+    from generativeaiexamples_tpu.serving.model_server import (
+        create_server_app)
+
+    params = _llama.init_params(LLAMA_TINY, _jax.random.key(0), _jnp.float32)
+    engine = Engine(params, LLAMA_TINY, ByteTokenizer(), EngineConfig(
+        max_slots=2, max_input_length=64, max_output_length=32,
+        prefill_buckets=(32, 64), dtype="float32", page_size=16,
+        kv_pool_tokens=None, steps_per_round=4))
+    app = create_server_app(engine, None, "tiny")
+
+    import asyncio as _asyncio
+    loop = _asyncio.new_event_loop()
+    box = {}
+    started = _threading.Event()
+
+    def run():
+        _asyncio.set_event_loop(loop)
+
+        async def boot():
+            runner = _web.AppRunner(app)
+            await runner.setup()
+            site = _web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            box["port"] = runner.addresses[0][1]
+        loop.run_until_complete(boot())
+        started.set()
+        loop.run_forever()
+
+    _threading.Thread(target=run, daemon=True).start()
+    started.wait(30)
+    base = f"http://127.0.0.1:{box['port']}"
+
+    trace_dir = str(tmp_path / "trace")
+    r = _requests.post(f"{base}/profiler/start", json={"dir": trace_dir},
+                       timeout=10)
+    assert r.ok and r.json()["status"] == "tracing"
+    # double-start conflicts
+    assert _requests.post(f"{base}/profiler/start", timeout=10
+                          ).status_code == 409
+    # do some device work under the trace
+    _jnp.ones((64, 64)).sum().block_until_ready()
+    r = _requests.post(f"{base}/profiler/stop", timeout=30)
+    assert r.ok and r.json()["dir"] == trace_dir
+    assert _glob.glob(f"{trace_dir}/**/*.pb*", recursive=True) or \
+        _glob.glob(f"{trace_dir}/**/*.json*", recursive=True)
+    assert _requests.post(f"{base}/profiler/stop", timeout=10
+                          ).status_code == 409
+    loop.call_soon_threadsafe(loop.stop)
+    engine.stop()
+
+
+def test_jobs_api_202_poll_contract(tmp_path):
+    """Submit-then-poll generation (the NVCF 202 semantics of the
+    reference's cloud connector, nv_aiplay.py:222-239)."""
+    import asyncio as _asyncio
+    import threading as _threading
+
+    import jax as _jax
+    import jax.numpy as _jnp
+    import requests as _requests
+    from aiohttp import web as _web
+
+    from generativeaiexamples_tpu.engine import Engine, EngineConfig
+    from generativeaiexamples_tpu.models import llama as _llama
+    from generativeaiexamples_tpu.models.configs import LLAMA_TINY
+    from generativeaiexamples_tpu.models.tokenizer import ByteTokenizer
+    from generativeaiexamples_tpu.serving.client import JobsClient
+    from generativeaiexamples_tpu.serving.model_server import (
+        create_server_app)
+
+    params = _llama.init_params(LLAMA_TINY, _jax.random.key(0), _jnp.float32)
+    engine = Engine(params, LLAMA_TINY, ByteTokenizer(), EngineConfig(
+        max_slots=2, max_input_length=64, max_output_length=64,
+        prefill_buckets=(32, 64), dtype="float32", page_size=16,
+        kv_pool_tokens=None, steps_per_round=4))
+    app = create_server_app(engine, None, "tiny")
+
+    loop = _asyncio.new_event_loop()
+    box = {}
+    started = _threading.Event()
+
+    def run():
+        _asyncio.set_event_loop(loop)
+
+        async def boot():
+            runner = _web.AppRunner(app)
+            await runner.setup()
+            site = _web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            box["port"] = runner.addresses[0][1]
+        loop.run_until_complete(boot())
+        started.set()
+        loop.run_forever()
+
+    _threading.Thread(target=run, daemon=True).start()
+    started.wait(30)
+    base = f"http://127.0.0.1:{box['port']}"
+    client = JobsClient(base, timeout=240)
+
+    # end-to-end: submit (may 200 fast-path or 202) then poll to done
+    text = client.generate("job prompt", max_tokens=8, top_k=1)
+    assert isinstance(text, str) and text
+
+    # explicit 202 path: first request compiles, so poll sees "running"
+    job = client.submit("second prompt", max_tokens=32, top_k=1)
+    assert job["status"] in ("running", "done")
+    final = client.wait(job["id"]) if job["status"] != "done" else job
+    assert final["status"] == "done"
+    assert final["finish_reason"] in ("length", "eos", "stop")
+
+    # unknown id -> 404; validation -> 422
+    assert _requests.get(f"{base}/v1/jobs/nope", timeout=10
+                         ).status_code == 404
+    assert _requests.post(f"{base}/v1/jobs", json={}, timeout=10
+                          ).status_code == 422
+
+    loop.call_soon_threadsafe(loop.stop)
+    engine.stop()
