@@ -76,14 +76,28 @@ class EngineLLM(LLM):
     def stream(self, prompt: str, max_tokens: int = 256,
                stop: Optional[list[str]] = None, temperature: float = 1.0,
                top_k: int = 1, top_p: float = 0.0) -> Iterator[str]:
+        import time
+
         from ..engine.sampling_params import SamplingParams
+        from ..obs.tracing import record_stage
         params = SamplingParams(max_tokens=max_tokens,
                                 stop_words=list(stop or []),
                                 temperature=temperature, top_k=top_k,
                                 top_p=top_p)
+        t0 = time.monotonic()
+        first = True
         stream = self.engine.stream_text(prompt, params)
         try:
-            yield from stream
+            for chunk in stream:
+                if first:
+                    # stage-breakdown hooks: time to the first visible
+                    # chunk (includes tokenize+queue+prefill+detok) and
+                    # the engine's own submit->first-token clock
+                    record_stage("llm_first_chunk", time.monotonic() - t0)
+                    if stream.ttft_ms is not None:
+                        record_stage("engine_ttft", stream.ttft_ms / 1e3)
+                    first = False
+                yield chunk
         finally:
             if stream.finish_reason is None:
                 # consumer abandoned the generator mid-stream: release the

@@ -27,6 +27,7 @@ class EmbeddingService:
 
     def __init__(self, params, cfg: EncoderConfig, tokenizer: Tokenizer,
                  max_length: int = 512, batch_buckets: Sequence[int] = (1, 8, 32),
+                 seq_buckets: Sequence[int] = (128, 512),
                  normalize: bool = True):
         import jax
         import jax.numpy as jnp
@@ -37,10 +38,19 @@ class EmbeddingService:
         self.tokenizer = tokenizer
         self.max_length = min(max_length, cfg.max_position_embeddings)
         self.batch_buckets = tuple(sorted(batch_buckets))
+        # Sequence buckets: a chat query is ~20 tokens — padding it to the
+        # passage length (512) made every query pay a full-length encoder
+        # pass on the TTFT-critical retrieve.
+        self.seq_buckets = tuple(sorted(
+            {min(s, self.max_length) for s in seq_buckets}
+            | {self.max_length}))
         self.normalize = normalize
         self.params = params
 
-        def encode_fn(params, tokens, mask):
+        def encode_fn(params, packed):
+            # tokens and mask ride ONE transfer: packed (2, B, S) int32 —
+            # each host->device hop on a tunneled device costs real ms.
+            tokens, mask = packed[0], packed[1]
             hidden = enc.apply(params, cfg, tokens, mask)
             return enc.mean_pool(hidden, mask, normalize=normalize)
 
@@ -79,17 +89,25 @@ class EmbeddingService:
         return out
 
     def _embed_chunk(self, texts: Sequence[str]) -> np.ndarray:
+        import time
+
+        from ..obs.tracing import record_stage
         jnp = self._jnp
         B = self._bucket(len(texts))
-        S = self.max_length
-        tokens = np.zeros((B, S), np.int32)
-        mask = np.zeros((B, S), np.int32)
-        for i, text in enumerate(texts):
-            ids = self.tokenizer.encode(text)[:S]
-            tokens[i, :len(ids)] = ids
-            mask[i, :len(ids)] = 1
-        emb = self._encode(self.params, jnp.asarray(tokens), jnp.asarray(mask))
-        return np.asarray(emb)[:len(texts)]
+        encoded = [self.tokenizer.encode(t)[:self.max_length] for t in texts]
+        longest = max((len(ids) for ids in encoded), default=1)
+        S = next(s for s in self.seq_buckets if longest <= s)
+        packed = np.zeros((2, B, S), np.int32)
+        for i, ids in enumerate(encoded):
+            packed[0, i, :len(ids)] = ids
+            packed[1, i, :len(ids)] = 1
+        t0 = time.monotonic()
+        emb = self._encode(self.params, jnp.asarray(packed))
+        t1 = time.monotonic()
+        out = np.asarray(emb)[:len(texts)]
+        record_stage("embed_dispatch", t1 - t0)
+        record_stage("embed_readback", time.monotonic() - t1)
+        return out
 
 
 class HashEmbedder:

@@ -41,6 +41,7 @@ import os
 
 from ..ops.attention import gqa_attention
 from ..ops.quant import matmul as qmm
+from ..ops.quant import matmul_f32 as qmm_f32
 from ..ops.rmsnorm import rmsnorm
 from ..ops.rope import apply_rope, rope_frequencies
 from .configs import LlamaConfig
@@ -334,12 +335,18 @@ def run_layers(layers: dict[str, jax.Array], cfg: LlamaConfig, h: jax.Array,
 
 
 def unembed(params: Params, cfg: LlamaConfig, h: jax.Array) -> jax.Array:
-    """Final norm + output projection: (B, S, D) -> (B, S, V) float32."""
+    """Final norm + output projection: (B, S, D) -> (B, S, V) float32.
+
+    Operands stay compact (bf16/int8) with f32 MXU accumulation — casting
+    to f32 first made XLA materialize an f32 copy of the whole vocab
+    projection every decode step (ops/quant.py matmul_f32)."""
     h = rmsnorm(h, params["final_norm"], cfg.rms_norm_eps)
     head = params.get("lm_head")
     if head is None:
-        return h.astype(jnp.float32) @ params["embed"].T.astype(jnp.float32)
-    return qmm(h.astype(jnp.float32), head)
+        return jax.lax.dot_general(
+            h, params["embed"], (((h.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    return qmm_f32(h, head)
 
 
 def apply(params: Params, cfg: LlamaConfig, tokens: jax.Array,

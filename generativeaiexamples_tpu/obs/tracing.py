@@ -105,6 +105,26 @@ def instrumented(name: str):
     return deco
 
 
+# Optional in-process stage-timing hook: callable(stage_name, seconds).
+# Installed by benchmarks/diagnostics (set_stage_collector) to get a
+# per-stage latency breakdown of the serving path without the OTel SDK —
+# every event_span reports its wall time here even when tracing is off.
+_stage_collector: Optional[Any] = None
+
+
+def set_stage_collector(cb: Optional[Any]) -> None:
+    """Install (or clear, with None) the process-local stage-timing hook."""
+    global _stage_collector
+    _stage_collector = cb
+
+
+def record_stage(name: str, seconds: float) -> None:
+    """Report one stage duration to the installed collector, if any."""
+    cb = _stage_collector
+    if cb is not None:
+        cb(name, seconds)
+
+
 @contextmanager
 def event_span(kind: str, **attributes: Any):
     """Child span for pipeline events — the first-party replacement for the
@@ -112,11 +132,17 @@ def event_span(kind: str, **attributes: Any):
     (reference: tools/observability/llamaindex/opentelemetry_callback.py:
     84-197 maps QUERY/RETRIEVE/EMBEDDING/SYNTHESIZE/LLM events to spans).
     Chains call this directly around retrieve/embed/generate stages."""
-    tracer = _get_tracer()
-    if tracer is None:
-        yield None
-        return
-    clean = {k: v for k, v in attributes.items()
-             if isinstance(v, (str, int, float, bool))}
-    with tracer.start_as_current_span(kind, attributes=clean) as span:
-        yield span
+    import time as _time
+    t0 = _time.monotonic() if _stage_collector is not None else 0.0
+    try:
+        tracer = _get_tracer()
+        if tracer is None:
+            yield None
+            return
+        clean = {k: v for k, v in attributes.items()
+                 if isinstance(v, (str, int, float, bool))}
+        with tracer.start_as_current_span(kind, attributes=clean) as span:
+            yield span
+    finally:
+        if _stage_collector is not None:
+            record_stage(kind, _time.monotonic() - t0)

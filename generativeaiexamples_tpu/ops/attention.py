@@ -24,6 +24,9 @@ import jax.numpy as jnp
 NEG_INF = -1e30  # large-negative instead of -inf: avoids NaN from 0*inf
 
 
+_CHUNK = 512  # key-block size for the online-softmax path
+
+
 def gqa_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                   q_positions: jax.Array, kv_valid_len: jax.Array | None = None,
                   *, causal: bool = True) -> jax.Array:
@@ -35,7 +38,23 @@ def gqa_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     causal: query at position p attends keys at cache indices <= p. The KV
         buffer is indexed by absolute position (index i holds the token at
         position i), which is what the slotted cache guarantees.
+
+    Long key buffers take a flash-style chunked path: keys are consumed in
+    ``_CHUNK`` blocks with an online softmax, so peak memory holds one
+    (B, KV, G, S, chunk) score block instead of the full (…, S, T) score
+    tensor — the difference between ~130 MB and ~1.1 GB of transient per
+    layer for a 2048-token llama-2-7b prefill, which is what let the KV
+    pool claim that HBM instead (round-4 sizing work).
     """
+    T = k.shape[1]
+    chunk = next((c for c in (_CHUNK, 256, 128) if T % c == 0), None)
+    if T > _CHUNK and chunk is not None:
+        return _gqa_chunked(q, k, v, q_positions, kv_valid_len,
+                            causal=causal, chunk=chunk)
+    return _gqa_dense(q, k, v, q_positions, kv_valid_len, causal=causal)
+
+
+def _gqa_dense(q, k, v, q_positions, kv_valid_len, *, causal):
     B, S, H, hd = q.shape
     _, T, KV, _ = k.shape
     G = H // KV
@@ -59,3 +78,48 @@ def gqa_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bkgst,btkh->bskgh", probs, vf)
     return out.reshape(B, S, H, hd).astype(q.dtype)
+
+
+def _gqa_chunked(q, k, v, q_positions, kv_valid_len, *, causal, chunk):
+    """Online-softmax over key blocks. Operands stay in their storage
+    dtype into the MXU (f32 accumulation via preferred_element_type) —
+    casting whole K/V to f32 up front doubled their HBM traffic."""
+    B, S, H, hd = q.shape
+    _, T, KV, _ = k.shape
+    G = H // KV
+    scale = 1.0 / (hd ** 0.5)
+    qr = q.reshape(B, S, KV, G, hd)
+    n_blocks = T // chunk
+
+    acc0 = jnp.zeros((B, KV, G, S, hd), jnp.float32)
+    m0 = jnp.full((B, KV, G, S, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, KV, G, S, 1), jnp.float32)
+
+    def body(i, carry):
+        acc, m, l = carry
+        kb = jax.lax.dynamic_slice_in_dim(k, i * chunk, chunk, axis=1)
+        vb = jax.lax.dynamic_slice_in_dim(v, i * chunk, chunk, axis=1)
+        scores = jnp.einsum("bskgh,btkh->bkgst", qr, kb,
+                            preferred_element_type=jnp.float32) * scale
+        key_idx = i * chunk + jnp.arange(chunk, dtype=jnp.int32)
+        mask = jnp.ones((B, S, chunk), dtype=bool)
+        if causal:
+            mask = key_idx[None, None, :] <= q_positions[:, :, None]
+        if kv_valid_len is not None:
+            mask = mask & (key_idx[None, None, :]
+                           < kv_valid_len[:, None, None])
+        maskb = mask[:, None, None, :, :]
+        scores = jnp.where(maskb, scores, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
+        # explicit zeroing (not exp of NEG-NEG): a fully-masked block
+        # would otherwise contribute exp(0)=1 per masked key
+        p = jnp.where(maskb, jnp.exp(scores - m_new), 0.0)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jnp.einsum("bkgst,btkh->bkgsh", p, vb.astype(jnp.float32))
+        return acc * alpha + pv, m_new, l
+
+    acc, m, l = jax.lax.fori_loop(0, n_blocks, body, (acc0, m0, l0))
+    out = acc / jnp.maximum(l, 1e-30)
+    # (B, KV, G, S, hd) -> (B, S, H, hd)
+    return out.transpose(0, 3, 1, 2, 4).reshape(B, S, H, hd).astype(q.dtype)

@@ -16,11 +16,14 @@ bottleneck and the round-2/3 OOMs in one:
 This kernel does the whole step natively instead: one program per slot,
 the block table and write location ride scalar prefetch (SMEM), the page
 window streams HBM->VMEM through a manual double-buffered DMA pipeline,
-attention accumulates page-by-page with an online softmax (flash style),
-and the new K/V row lands in the pool via an aligned read-modify-write of
-its 8-row tile — the pool is aliased in/out (``input_output_aliases``), so
-the whole decode step leaves the pool in place, in one layout, with zero
-XLA gathers/scatters/copies.
+attention accumulates page-by-page with an online softmax (flash style)
+over a PER-SLOT dynamic page count (HBM reads follow each sequence's live
+length, not the batch max), and the new K/V row lands in the pool via an
+aligned 8-row-tile write whose preserved rows come from the already-
+streamed window page — no read-modify-write round trip. The pool is
+aliased in/out (``input_output_aliases``), so the whole decode step
+leaves the pool in place, in one layout, with zero XLA
+gathers/scatters/copies.
 
 Same role as the paged-KV device kernels the reference gets from the
 TRT-LLM C++ backend (reference: ensemble_models/llama/tensorrt_llm/
@@ -85,9 +88,16 @@ def paged_attention_decode(q: jax.Array, pool_k: jax.Array,
         # One program per slot; the page window streams through a manual
         # double-buffered DMA pipeline (a page-per-grid-step layout was
         # measured ~4x slower: B*W*L tiny programs of fixed overhead
-        # swamped the 2 MB of useful work each).
+        # swamped the 2 MB of useful work each). The loop trip count is
+        # the slot's OWN live page count, not the static table width — HBM
+        # traffic follows each sequence's actual length (a finished or
+        # short slot streams nothing), which is what makes throughput
+        # monotone in slot count instead of every slot paying the longest
+        # sequence's window.
         b = pl.program_id(0)
         li = l_ref[0]
+        length = len_ref[b]
+        n_pages = jax.lax.div(length + (page - 1), page)  # dynamic bound
 
         def kdma(slot, w):
             return pltpu.make_async_copy(k_hbm.at[li, tbl_ref[b, w]],
@@ -97,27 +107,20 @@ def paged_attention_decode(q: jax.Array, pool_k: jax.Array,
             return pltpu.make_async_copy(v_hbm.at[li, tbl_ref[b, w]],
                                          vbuf.at[slot], sem.at[slot, 1])
 
-        kdma(0, 0).start()
-        vdma(0, 0).start()
-        # Kick off the write page's read while the window streams (DMA
-        # slices need statically-aligned starts, so RMW granularity is the
-        # whole page: ~1 MB extra traffic per slot-layer, noise next to
-        # the window stream).
-        wp = wp_ref[b]
-        krd = pltpu.make_async_copy(k_hbm.at[li, wp], krw, rw_sem.at[0])
-        vrd = pltpu.make_async_copy(v_hbm.at[li, wp], vrw, rw_sem.at[1])
-        krd.start()
-        vrd.start()
+        @pl.when(n_pages > 0)
+        def _():
+            kdma(0, 0).start()
+            vdma(0, 0).start()
 
+        wp = wp_ref[b]
         qv = q_ref[0].reshape(KV, G, hd)
-        length = len_ref[b]
 
         def body(w, carry):
             acc, m, l = carry
             slot = jax.lax.rem(w, 2)
             nxt = jax.lax.rem(w + 1, 2)
 
-            @pl.when(w + 1 < W)
+            @pl.when(w + 1 < n_pages)
             def _():
                 kdma(nxt, w + 1).start()
                 vdma(nxt, w + 1).start()
@@ -147,7 +150,7 @@ def paged_attention_decode(q: jax.Array, pool_k: jax.Array,
         acc0 = jnp.zeros((KV, G, hd), jnp.float32)
         m0 = jnp.full((KV, G, 1), NEG, jnp.float32)
         l0 = jnp.zeros((KV, G, 1), jnp.float32)
-        acc, m, l = jax.lax.fori_loop(0, W, body, (acc0, m0, l0))
+        acc, m, l = jax.lax.fori_loop(0, n_pages, body, (acc0, m0, l0))
 
         # Fold in the current token (not yet pooled) — exact via partials.
         ck = ck_ref[0].astype(jnp.float32)                     # (KV,hd)
@@ -161,19 +164,27 @@ def paged_attention_decode(q: jax.Array, pool_k: jax.Array,
         denom = l * a + bta
         out_ref[0] = (out / denom).reshape(H, hd).astype(out_ref.dtype)
 
-        # Append the new row: read-modify-write of its aligned 8-row tile
-        # (sub-tile HBM DMA is not allowed). Attention reads rows < pos and
-        # the write is at row pos, so ordering vs the window reads is free.
-        krd.wait()
-        vrd.wait()
-        # Insert the row vectorized (dynamic sublane stores need 8-aligned
-        # indices; a masked merge over the page has no such constraint).
+        # Append the new row WITHOUT a read-modify-write round trip to HBM:
+        # the rows that must be preserved (rows < off of the write page)
+        # are already in VMEM — when off > 0 the write page IS the last
+        # streamed window page (index n_pages-1). When off == 0 the page
+        # is fresh: rows > 0 hold garbage until the step that writes each
+        # row, and attention masks rows >= length, so garbage is never
+        # read. Only the aligned 8-row tile containing the new row is
+        # DMA'd back — 1/16th of a page instead of a full-page read+write.
+        off = off_ref[b]
+        tile0 = (off // _TILE) * _TILE
+        last = jnp.maximum(n_pages - 1, 0)
+        src_k = kbuf[jax.lax.rem(last, 2), :, pl.ds(tile0, _TILE), :]
+        src_v = vbuf[jax.lax.rem(last, 2), :, pl.ds(tile0, _TILE), :]
         row_mask = jax.lax.broadcasted_iota(
-            jnp.int32, (1, page, 1), 1) == off_ref[b]
-        krw[:] = jnp.where(row_mask, ck_ref[0][:, None, :], krw[:])
-        vrw[:] = jnp.where(row_mask, cv_ref[0][:, None, :], vrw[:])
-        kwr = pltpu.make_async_copy(krw, opk_ref.at[li, wp], rw_sem.at[0])
-        vwr = pltpu.make_async_copy(vrw, opv_ref.at[li, wp], rw_sem.at[1])
+            jnp.int32, (1, _TILE, 1), 1) == (off - tile0)
+        krw[:] = jnp.where(row_mask, ck_ref[0][:, None, :], src_k)
+        vrw[:] = jnp.where(row_mask, cv_ref[0][:, None, :], src_v)
+        kwr = pltpu.make_async_copy(
+            krw, opk_ref.at[li, wp, :, pl.ds(tile0, _TILE)], rw_sem.at[0])
+        vwr = pltpu.make_async_copy(
+            vrw, opv_ref.at[li, wp, :, pl.ds(tile0, _TILE)], rw_sem.at[1])
         kwr.start()
         vwr.start()
         kwr.wait()
@@ -197,8 +208,8 @@ def paged_attention_decode(q: jax.Array, pool_k: jax.Array,
         scratch_shapes=[
             pltpu.VMEM((2, KV, page, hd), pool_k.dtype),
             pltpu.VMEM((2, KV, page, hd), pool_v.dtype),
-            pltpu.VMEM((KV, page, hd), pool_k.dtype),
-            pltpu.VMEM((KV, page, hd), pool_v.dtype),
+            pltpu.VMEM((KV, _TILE, hd), pool_k.dtype),
+            pltpu.VMEM((KV, _TILE, hd), pool_v.dtype),
             pltpu.SemaphoreType.DMA((2, 2)),
             pltpu.SemaphoreType.DMA((2,)),
         ],

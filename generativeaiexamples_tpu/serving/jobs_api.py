@@ -77,9 +77,11 @@ def add_jobs_routes(app: web.Application, engine, model_name: str,
 
     async def submit(request: web.Request) -> web.Response:
         _reap()
-        with lock:
-            if len(jobs) >= _MAX_JOBS:
-                raise web.HTTPTooManyRequests(text="job table full")
+        # Best-effort early reject (unlocked, so overload bursts don't
+        # burn body parsing + engine prefill on doomed requests); the
+        # authoritative check-and-insert below holds the lock.
+        if len(jobs) >= _MAX_JOBS:
+            raise web.HTTPTooManyRequests(text="job table full")
         body = await request.json()
         prompt = str(body.get("prompt", ""))
         if not prompt:
@@ -91,7 +93,13 @@ def add_jobs_routes(app: web.Application, engine, model_name: str,
         except (ValueError, EngineError) as exc:
             raise web.HTTPBadRequest(text=str(exc)) from exc
         job = _Job(id=f"job-{uuid.uuid4().hex[:16]}", stream=stream)
+        # Capacity check and insert under ONE lock hold: checking before
+        # the (awaited) body parse let concurrent submits race past the
+        # cap and overfill the table.
         with lock:
+            if len(jobs) >= _MAX_JOBS:
+                stream.cancel()
+                raise web.HTTPTooManyRequests(text="job table full")
             jobs[job.id] = job
         threading.Thread(target=_collector, args=(job,), daemon=True,
                          name=f"job-{job.id}").start()

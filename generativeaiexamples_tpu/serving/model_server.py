@@ -209,6 +209,11 @@ def build_services(model_type: str = "dev", model_name: str = "",
         max_slots=max_slots, max_input_length=max_input_length,
         max_output_length=max_output_length, dtype=dtype, seed=seed)
     engine = Engine(params, cfg, tokenizer, engine_cfg, mesh=mesh)
+    # Allocate-and-verify before serving: worst-case prefill/insert/round
+    # transients run once and the pool shrinks on OOM instead of dying
+    # mid-request (tunneled TPUs allocate lazily and report no
+    # memory_stats, so the auto-sizer's estimate needs confirmation).
+    engine.prewarm()
 
     embed_service = None
     if with_embedder:

@@ -49,25 +49,48 @@ def test_kernel_matches_reference(B, H, W, lengths):
 
 
 def test_kernel_writes_row_in_place():
-    B, H, W = 2, 8, 2
-    q, pk, pv, table, lens, ck, cv = _setup(B, H, W, [20, 40])
-    wp = jnp.asarray([3, 7], jnp.int32)
-    off = jnp.asarray([20 % page, 40 % page], jnp.int32)
+    """KV append contract (matches the engine's invariant wp ==
+    table[pos // page]): the new row lands at (layer, wp, :, off); every
+    row < length anywhere in the pool is preserved; rows >= length inside
+    the written 8-row tile are DON'T-CARE (the zero-copy append sources
+    preserved rows from the streamed window page instead of re-reading
+    the write page, so dead rows may hold garbage — attention masks
+    them). Covers off > 0 (write page == last streamed page) and
+    off == 0 (fresh page, nothing to preserve)."""
+    B, H, W = 3, 8, 3
+    lengths = [20, 33, 16]                   # offs 4, 1, 0 (fresh page)
+    q, pk, pv, table, lens, ck, cv = _setup(B, H, W, lengths)
+    tbl = np.asarray(table)
+    wp = jnp.asarray([tbl[b, lengths[b] // page] for b in range(B)],
+                     jnp.int32)
+    off = lens % page
     layer = jnp.ones((1,), jnp.int32)        # write layer 1
     before_k = np.asarray(pk)
+    before_v = np.asarray(pv)
     _, new_k, new_v = paged_attention_decode(q, pk, pv, table, lens, ck, cv,
                                              wp, off, layer, interpret=True)
     nk = np.array(new_k)
     nv = np.array(new_v)
+    tile = 8
     for b in range(B):
-        np.testing.assert_allclose(nk[1, int(wp[b]), :, int(off[b]), :],
-                                   np.asarray(ck)[b], rtol=1e-6)
-        np.testing.assert_allclose(nv[1, int(wp[b]), :, int(off[b]), :],
-                                   np.asarray(cv)[b], rtol=1e-6)
-    # everything else untouched (zero out the written rows, compare)
-    nk[1, np.asarray(wp), :, np.asarray(off), :] = \
-        before_k[1, np.asarray(wp), :, np.asarray(off), :]
-    np.testing.assert_array_equal(nk, before_k)
+        w, o = int(wp[b]), int(off[b])
+        np.testing.assert_allclose(nk[1, w, :, o, :], np.asarray(ck)[b],
+                                   rtol=1e-6)
+        np.testing.assert_allclose(nv[1, w, :, o, :], np.asarray(cv)[b],
+                                   rtol=1e-6)
+        # live rows below the new one inside the written tile survive
+        t0 = o // tile * tile
+        np.testing.assert_array_equal(nk[1, w, :, t0:o, :],
+                                      before_k[1, w, :, t0:o, :])
+        np.testing.assert_array_equal(nv[1, w, :, t0:o, :],
+                                      before_v[1, w, :, t0:o, :])
+    # everything outside the written tiles is untouched
+    keep = np.ones(nk.shape, bool)
+    for b in range(B):
+        t0 = int(off[b]) // tile * tile
+        keep[1, int(wp[b]), :, t0:t0 + tile, :] = False
+    np.testing.assert_array_equal(nk[keep], before_k[keep])
+    np.testing.assert_array_equal(nv[keep], before_v[keep])
 
 
 def test_kernel_supported_gate():

@@ -1,0 +1,60 @@
+"""Isolate the lm_head projection cost: which dot formulation streams the
+int8 vocab matrix at HBM speed? Run on TPU."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+B, D, V = 8, 4096, 32000
+h = jnp.ones((B, D), jnp.bfloat16)
+wq = jnp.ones((D, V), jnp.int8)
+scale = jnp.ones((V,), jnp.float32)
+wb = jnp.ones((D, V), jnp.bfloat16)
+
+
+def timeit(f, *a, n=30):
+    g = jax.jit(f)
+    for _ in range(3):
+        out = g(*a)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    outs = [g(*a) for _ in range(n)]
+    jax.block_until_ready(outs)
+    return (time.perf_counter() - t0) / n * 1e3
+
+
+dims = (((1,), (0,)), ((), ()))
+
+ms = timeit(lambda h, w: jax.lax.dot_general(
+    h, w, dims, preferred_element_type=jnp.float32) * scale, h, wq)
+print(f"bf16 x s8 (pref f32): {ms:.3f} ms ({wq.nbytes/ms*1e3/1e9:.0f} GB/s)")
+
+ms = timeit(lambda h, w: jax.lax.dot_general(
+    h, w.astype(jnp.bfloat16), dims,
+    preferred_element_type=jnp.float32) * scale, h, wq)
+print(f"s8->bf16 cast dot:    {ms:.3f} ms ({wq.nbytes/ms*1e3/1e9:.0f} GB/s)")
+
+ms = timeit(lambda h, w: jax.lax.dot_general(
+    h.astype(jnp.float32), w.astype(jnp.float32), dims) * scale, h, wq)
+print(f"f32 cast dot:         {ms:.3f} ms ({wq.nbytes/ms*1e3/1e9:.0f} GB/s)")
+
+ms = timeit(lambda h, w: jax.lax.dot_general(
+    h, w, dims, preferred_element_type=jnp.float32), h, wb)
+print(f"bf16 x bf16 pref f32: {ms:.3f} ms ({wb.nbytes/ms*1e3/1e9:.0f} GB/s)")
+
+ms = timeit(lambda h, w: (
+    jax.lax.dot_general(h, w, dims,
+                        preferred_element_type=jnp.bfloat16)
+    .astype(jnp.float32) * scale), h, wq)
+print(f"bf16 x s8 (pref bf16): {ms:.3f} ms ({wq.nbytes/ms*1e3/1e9:.0f} GB/s)")
+
+# layer-matmul shape for comparison: (8,4096) @ (4096,11008) int8
+wl = jnp.ones((4096, 11008), jnp.int8)
+ms = timeit(lambda h, w: jax.lax.dot_general(
+    h, w, dims, preferred_element_type=jnp.float32), h, wl)
+print(f"layer-shape bf16 x s8: {ms:.3f} ms ({wl.nbytes/ms*1e3/1e9:.0f} GB/s)")
