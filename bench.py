@@ -319,15 +319,27 @@ def run_e2e_bench(engine, embedder, n_requests: int):
             # First byte, or EOF for a zero-visible-token generation
             # (random-weight greedy decode can hit eos immediately) —
             # either way the retrieve->embed->prefill path completed.
-            for _ in resp.iter_content(chunk_size=1):
+            tail = b""
+            # ONE iter_content generator for first-byte + drain: a second
+            # generator on a partially-consumed chunked stream terminates
+            # it early (observed: 1-byte bodies while the engine kept
+            # generating — which also poisoned the next request's TTFT
+            # with the orphaned decode round).
+            it = resp.iter_content(chunk_size=1)
+            for b in it:
+                tail = b
                 break
             dt = (time.monotonic() - t0) * 1e3
             # Drain the rest: a sequential chat user reads the full
-            # answer before asking again — abandoning mid-stream left
-            # the tail decode round polluting the NEXT request's
-            # retrieve with queued device work.
-            for _ in resp.iter_content(chunk_size=4096):
-                pass
+            # answer before asking again.
+            for b in it:
+                tail += b
+            # The server degrades failures into the stream (reference
+            # semantics) — a bench that timed the error banner's first
+            # byte would report fiction.
+            if b"[error]" in tail:
+                raise RuntimeError(
+                    f"e2e generation failed in-stream: {tail[:200]!r}")
         all_stages.append(dict(stages))
         return dt
 

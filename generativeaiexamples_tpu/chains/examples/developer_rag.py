@@ -32,7 +32,7 @@ class QAChatbot(BaseExample):
     """Canonical developer RAG chatbot."""
 
     def __init__(self, llm=None, embedder=None, index: Optional[DocumentIndex] = None,
-                 config=None, engine=None):
+                 config=None, engine=None, fused_rag: Optional[bool] = None):
         self.config = config or get_config()
         self.llm = llm or get_llm(self.config, engine=engine)
         embedder = embedder or (index.embedder if index else None) or \
@@ -47,6 +47,14 @@ class QAChatbot(BaseExample):
         self.splitter = TokenTextSplitter(
             chunk_size=self.config.text_splitter.chunk_size,
             chunk_overlap=self.config.text_splitter.chunk_overlap)
+        # Fused on-device RAG (engine/rag_fusion.py): None = auto-enable
+        # when the LLM is an in-process engine, the embedder runs
+        # on-device (has params), and the store can export raw vectors.
+        if fused_rag is None:
+            fused_rag = os.environ.get("GENAI_TPU_FUSED_RAG", "1") != "0"
+        self._fused_requested = fused_rag
+        self._fused_ready = False
+        self._fused_sources: list[int] = []
 
     # ----------------------------------------------------------- ingestion
 
@@ -65,6 +73,60 @@ class QAChatbot(BaseExample):
                 for i, c in enumerate(chunks)]
         self.index.add_documents(docs)
         logger.info("ingested %s: %d chunks", filename, len(chunks))
+        self._sync_fused_corpus()
+
+    def _sync_fused_corpus(self) -> None:
+        """Mirror the corpus onto the device for fused-RAG admission.
+        Best-effort: any miss (remote store, host-only embedder, remote
+        LLM) just leaves the classic host path in charge."""
+        self._fused_ready = False
+        if not self._fused_requested:
+            return
+        from ..llm import EngineLLM
+        if not isinstance(self.llm, EngineLLM):
+            return
+        emb = self.index.embedder
+        if not (hasattr(emb, "params") and hasattr(emb, "cfg")):
+            return
+        data = self.index.export_corpus()
+        if data is None or not data[0]:
+            return
+        try:
+            from ...engine.rag_fusion import (FusedRagSpec,
+                                              build_prompt_parts,
+                                              corpus_rows)
+            engine = self.llm.engine
+            parts = build_prompt_parts(
+                self.config.prompts.rag_template, engine.tokenizer)
+            C = self.config.text_splitter.chunk_size + 32
+            K = self.config.retriever.top_k
+            ids, vecs, texts = data
+            toks, lens = corpus_rows(texts, engine.tokenizer, C)
+            # Bucket sized to what retrieval can actually assemble from
+            # THIS corpus (k largest chunks + separators), not the
+            # worst-case config budget — the prompt bucket sets prefill
+            # FLOPs, which sit on the TTFT-critical path.
+            top_lens = sorted(int(n) for n in lens)[-K:]
+            budget = min(self.config.retriever.max_context_tokens,
+                         sum(top_lens) + K * len(parts["sep_ids"]))
+            overhead = (len(parts["prefix_ids"]) + len(parts["mid_ids"])
+                        + len(parts["suffix_ids"]) + 64)
+            page = engine.cfg.page_size
+            bucket = -(-(overhead + budget) // page) * page
+            bucket = min(bucket, (engine.cfg.max_cache_len // page - 1)
+                         * page)
+            spec = FusedRagSpec(**parts, top_k=K, ctx_budget=budget,
+                                bucket=bucket, chunk_tokens=C,
+                                q_bucket=64, enc_bucket=128)
+            if (engine._fused_rag is None
+                    or engine._fused_rag.spec != spec):
+                engine.enable_fused_rag(emb.params, emb.cfg, spec)
+            engine.set_rag_corpus(vecs, toks, lens)
+            self._fused_doc_ids = ids
+            self._fused_ready = True
+        except Exception:  # noqa: BLE001 — fused is an optimization
+            logger.exception("fused-RAG corpus sync failed; "
+                             "using the host retrieval path")
 
     # -------------------------------------------------------------- chains
 
@@ -78,6 +140,31 @@ class QAChatbot(BaseExample):
 
     def rag_chain(self, prompt: str, num_tokens: int,
                   ) -> Generator[str, None, None]:
+        spec = (self.llm.engine._fused_rag.spec
+                if self._fused_ready else None)
+        q_fits = spec is not None and len(self.llm.engine.tokenizer.encode(
+            prompt, add_bos=False)) <= spec.q_bucket
+        if self._fused_ready and q_fits:
+            # Retrieval + prompt assembly + prefill fused into the
+            # engine's admission program: one device dispatch, one
+            # readback — the whole RAG hot path without host hops.
+            # (Over-long questions fall through to the host path, which
+            # has no question-length bucket.)
+            emb = self.index.embedder
+            enc_ids = emb.tokenizer.encode(f"query: {prompt}")
+
+            def keep_sources(rows: list[int]) -> None:
+                # map on-device corpus rows back to document metadata —
+                # the fused analogue of document_search attribution
+                ids = getattr(self, "_fused_doc_ids", [])
+                self._fused_sources = [ids[r] for r in rows
+                                       if 0 <= r < len(ids)]
+
+            with event_span("llm", fused_rag=True, num_tokens=num_tokens):
+                yield from self.llm.stream_rag(
+                    prompt, enc_ids, max_tokens=num_tokens,
+                    stop=["</s>", "[INST]"], on_sources=keep_sources)
+            return
         # Child spans per pipeline stage — the retrieve/synthesize/llm
         # events the reference bridges out of LlamaIndex callbacks
         # (reference: tools/observability/llamaindex/
@@ -101,6 +188,19 @@ class QAChatbot(BaseExample):
                         prompt_chars=len(full_prompt)):
             yield from self.llm.stream(full_prompt, max_tokens=num_tokens,
                                        stop=["</s>", "[INST]"])
+
+    @property
+    def last_sources(self) -> list[dict]:
+        """Source attribution of the most recent fused-RAG answer
+        (document metadata of the chunks the on-device retrieval picked).
+        Empty when the host path served the last request."""
+        out = []
+        for i in self._fused_sources:
+            doc = self.index.get(i)
+            if doc is not None:
+                out.append({"source": doc.metadata.get("source", ""),
+                            "chunk": doc.metadata.get("chunk")})
+        return out
 
     # ------------------------------------------------------------- search
 

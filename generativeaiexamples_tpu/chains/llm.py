@@ -79,14 +79,42 @@ class EngineLLM(LLM):
         import time
 
         from ..engine.sampling_params import SamplingParams
-        from ..obs.tracing import record_stage
         params = SamplingParams(max_tokens=max_tokens,
                                 stop_words=list(stop or []),
                                 temperature=temperature, top_k=top_k,
                                 top_p=top_p)
-        t0 = time.monotonic()
-        first = True
         stream = self.engine.stream_text(prompt, params)
+        yield from self._consume(stream, time.monotonic())
+
+    def stream_rag(self, question: str, enc_ids: list,
+                   max_tokens: int = 256,
+                   stop: Optional[list[str]] = None,
+                   temperature: float = 1.0, top_k: int = 1,
+                   top_p: float = 0.0, on_sources=None) -> Iterator[str]:
+        """Fused-RAG generation: retrieval + prompt assembly + prefill run
+        as one device program inside the engine (engine/rag_fusion.py).
+        ``enc_ids``: the question's tokens in the ENCODER vocabulary,
+        query prefix included. ``on_sources`` (optional callable) receives
+        the retrieved corpus row ids once they are known — the on-device
+        retrieval's answer to the host path's similarity_search result."""
+        import time
+
+        from ..engine.sampling_params import SamplingParams
+        params = SamplingParams(max_tokens=max_tokens,
+                                stop_words=list(stop or []),
+                                temperature=temperature, top_k=top_k,
+                                top_p=top_p)
+        self.engine.start()
+        q_ids = self.engine.tokenizer.encode(question, add_bos=False)
+        stream = self.engine.submit_rag(q_ids, enc_ids, params)
+        yield from self._consume(stream, time.monotonic(),
+                                 on_sources=on_sources)
+
+    def _consume(self, stream, t0: float, on_sources=None) -> Iterator[str]:
+        import time
+
+        from ..obs.tracing import record_stage
+        first = True
         try:
             for chunk in stream:
                 if first:
@@ -96,6 +124,8 @@ class EngineLLM(LLM):
                     record_stage("llm_first_chunk", time.monotonic() - t0)
                     if stream.ttft_ms is not None:
                         record_stage("engine_ttft", stream.ttft_ms / 1e3)
+                    if on_sources is not None and stream.source_ids:
+                        on_sources(stream.source_ids)
                     first = False
                 yield chunk
         finally:

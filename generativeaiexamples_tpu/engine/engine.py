@@ -104,6 +104,9 @@ class TokenStream:
         self.first_token_time: Optional[float] = None
         self.finish_time: Optional[float] = None
         self.cancelled = False
+        # Fused-RAG requests: corpus row ids the on-device retrieval
+        # picked (populated at first-token harvest).
+        self.source_ids: list[int] = []
 
     def _put_chunk(self, text: str) -> None:
         if text:
@@ -159,6 +162,9 @@ class _Request:
     generated: int = 0
     greedy: bool = False      # top_k==1 / temp<=0: argmax fast path
     banned_ids: list[int] = field(default_factory=list)
+    # Fused-RAG payload (q_llm (Sq,) int32, q_llm_len, q_enc (2, Se)):
+    # admission runs the on-device retrieve+assemble+prefill program.
+    rag: Optional[tuple] = None
 
     @property
     def done(self) -> bool:
@@ -192,15 +198,17 @@ class Engine:
             {page_up(min(b, cfg.max_input_length)) for b in cfg.prefill_buckets}
             | {page_up(cfg.max_input_length)}))
 
-        # The Pallas decode kernel is single-device (no SPMD partitioning
-        # rule); mesh serving takes the jnp gather path. When the kernel is
-        # in play the pool layout is pinned row-major — without pinning,
-        # XLA keeps the pre-transpose physical layout and inserts a
-        # full-pool relayout copy (2x pool HBM) inside every decode round.
-        # Decided BEFORE pool sizing: the auto sizer's headroom reserve
-        # depends on whether the gather window ever materializes.
-        self._use_kernel = (mesh is None
-                            and llama.use_paged_kernel(model_cfg, page))
+        # The Pallas decode kernel has no SPMD partitioning rule, so mesh
+        # serving shard_maps it over tp when the head counts divide
+        # (models/llama.py:kernel_tp_compatible) and otherwise falls back
+        # to the jnp gather path. When the kernel is in play the pool
+        # layout is pinned row-major — without pinning, XLA keeps the
+        # pre-transpose physical layout and inserts a full-pool relayout
+        # copy (2x pool HBM) inside every decode round. Decided BEFORE
+        # pool sizing: the auto sizer's headroom reserve depends on
+        # whether the gather window ever materializes.
+        self._use_kernel = (llama.use_paged_kernel(model_cfg, page)
+                            and llama.kernel_tp_compatible(model_cfg, mesh))
         self._pin_layouts = self._use_kernel
 
         # Page pool: physical page 0 is the trash page (never allocated);
@@ -212,6 +220,8 @@ class Engine:
         self._step_counter = itertools.count()
         self._req_counter = itertools.count()
 
+        self._fused_rag = None           # set by enable_fused_rag()
+        self._rag_jit = None
         self._slots: dict[int, _Request] = {}
         self._free_slots = list(range(B))
         self._pending: "queue.Queue[tuple[_Request, SamplingParams]]" = (
@@ -608,7 +618,7 @@ class Engine:
                         params, mcfg, st["last_token"][:, None],
                         eff_pos[:, None], st["cache"], st["table"][:, :window],
                         pos + 1, wp, eff_pos % page,
-                        use_kernel=self._use_kernel)
+                        use_kernel=self._use_kernel, mesh=self.mesh)
                     penalized = apply_repetition_penalty(
                         logits[:, 0], st["seen"], st["rep_pen"])
                     penalized = jnp.where(st["banned"], -1e30, penalized)
@@ -659,6 +669,7 @@ class Engine:
 
         self._prefill_insert = jax.jit(prefill_insert, static_argnums=(14,),
                                        donate_argnums=(0,))
+        self._prefill_insert_raw = prefill_insert  # for fused-RAG composition
         self._release = jax.jit(release, donate_argnums=(0,))
         self._make_round = make_round
         self._round_fns: dict[tuple[int, int, bool], object] = {}
@@ -792,25 +803,7 @@ class Engine:
 
     # ------------------------------------------------------------------ API
 
-    def submit(self, prompt_ids: Sequence[int],
-               params: Optional[SamplingParams] = None) -> TokenStream:
-        """Enqueue a request; returns its stream immediately."""
-        if self._fatal is not None:
-            raise EngineError("engine is dead") from self._fatal
-        params = params or SamplingParams()
-        if len(prompt_ids) > self.cfg.max_input_length:
-            raise EngineError(
-                f"prompt length {len(prompt_ids)} exceeds max_input_length "
-                f"{self.cfg.max_input_length}")
-        if len(prompt_ids) == 0:
-            raise EngineError("empty prompt")
-        eff_max = min(params.max_tokens,
-                      self.cfg.max_cache_len - len(prompt_ids))
-        need = _ceil_div(len(prompt_ids) + eff_max, self.cfg.page_size)
-        if need > self._n_pages - 1:
-            raise EngineError(
-                f"request needs {need} KV pages but the pool only has "
-                f"{self._n_pages - 1} (kv_pool_tokens too small)")
+    def _banned_ids(self, params: SamplingParams) -> list[int]:
         banned_ids: list[int] = []
         for word in params.bad_words:
             # Subword tokenizers give a word several single-token
@@ -835,6 +828,117 @@ class Engine:
                     "only single-token bans are supported (device-side "
                     "sequence banning is not implemented)")
             banned_ids.extend(variants)
+        return banned_ids
+
+    # -------------------------------------------------------- fused RAG
+
+    def enable_fused_rag(self, enc_params, enc_cfg, spec) -> None:
+        """Compile-in the on-device retrieve->assemble->prefill admission
+        (engine/rag_fusion.py). ``spec``: FusedRagSpec. The corpus is
+        uploaded separately via set_rag_corpus()."""
+        from .rag_fusion import FusedRag
+        if spec.bucket % self.cfg.page_size:
+            raise EngineError("fused-RAG bucket must be a page multiple")
+        if spec.bucket + 1 > self.cfg.max_cache_len:
+            raise EngineError("fused-RAG bucket exceeds the cache extent")
+        fused = FusedRag(enc_params, enc_cfg, spec)
+
+        def rag_admit(state, params, enc_params, corpus, q_enc, q_llm,
+                      q_llm_len, slot, row, temp, top_k, top_p, rep_pen,
+                      banned, key, remaining, eos_ok, greedy: bool):
+            tokens, length, top_ids = fused.assemble(
+                enc_params, corpus, q_enc, q_llm, q_llm_len)
+            new_state, first = self._prefill_insert_raw(
+                state, params, tokens[None, :], length, slot, row, temp,
+                top_k, top_p, rep_pen, banned, key, remaining, eos_ok,
+                greedy)
+            # One readback for everything the host needs: token, real
+            # prompt length, retrieved corpus rows.
+            aux = jnp.concatenate([
+                first[None].astype(jnp.int32), length[None], top_ids])
+            return new_state, aux
+
+        self._fused_rag = fused
+        self._rag_jit = jax.jit(rag_admit, static_argnums=(17,),
+                                donate_argnums=(0,))
+
+    def set_rag_corpus(self, emb, toks, lens) -> None:
+        """Upload/replace the device-resident retrieval corpus
+        (rag_fusion.corpus_rows builds toks/lens from chunk texts)."""
+        if self._fused_rag is None:
+            raise EngineError("enable_fused_rag() first")
+        self._fused_rag.set_corpus(emb, toks, lens)
+
+    def submit_rag(self, question_ids: Sequence[int],
+                   question_enc_ids: Sequence[int],
+                   params: Optional[SamplingParams] = None) -> TokenStream:
+        """Enqueue a fused-RAG request: retrieval and prompt assembly
+        happen on-device during admission; ``question_ids`` are the
+        question's tokens in the LLM vocab (no BOS), ``question_enc_ids``
+        in the encoder vocab (with any query prefix applied)."""
+        if self._fatal is not None:
+            raise EngineError("engine is dead") from self._fatal
+        if self._fused_rag is None:
+            raise EngineError("fused RAG is not enabled on this engine")
+        params = params or SamplingParams()
+        spec = self._fused_rag.spec
+        ids = list(question_ids)
+        if len(ids) > spec.q_bucket:
+            # mirror submit()'s loud rejection — silently cutting the
+            # question mid-sentence would answer a different question
+            raise EngineError(
+                f"question is {len(ids)} tokens but the fused-RAG "
+                f"question bucket is {spec.q_bucket}; use the host "
+                "retrieval path for long questions")
+        q_llm = np.zeros((spec.q_bucket,), np.int32)
+        q_llm[:len(ids)] = ids
+        q_enc = np.zeros((2, spec.enc_bucket), np.int32)
+        eids = list(question_enc_ids)[:spec.enc_bucket]
+        q_enc[0, :len(eids)] = eids
+        q_enc[1, :len(eids)] = 1
+        eff_max = min(params.max_tokens,
+                      self.cfg.max_cache_len - spec.bucket)
+        if eff_max < 1:
+            raise EngineError("fused-RAG bucket leaves no room to decode")
+        stream = TokenStream(next(self._req_counter))
+        req = _Request(stream=stream, prompt_ids=[], params=params,
+                       eff_max=eff_max, extent=spec.bucket + eff_max,
+                       detok=IncrementalDetokenizer(self.tokenizer),
+                       stop=StopChecker(params.stop_words),
+                       greedy=(params.top_k == 1 or params.temperature <= 0),
+                       banned_ids=self._banned_ids(params),
+                       rag=(q_llm, len(ids), q_enc))
+        try:
+            self._pending.put_nowait((req, params))
+        except queue.Full:
+            raise SchedulerFullError(
+                f"request queue full ({self.cfg.max_queue})") from None
+        if self._fatal is not None:
+            stream._fail(self._fatal)
+        self._bump("requests")
+        self._wake.set()
+        return stream
+
+    def submit(self, prompt_ids: Sequence[int],
+               params: Optional[SamplingParams] = None) -> TokenStream:
+        """Enqueue a request; returns its stream immediately."""
+        if self._fatal is not None:
+            raise EngineError("engine is dead") from self._fatal
+        params = params or SamplingParams()
+        if len(prompt_ids) > self.cfg.max_input_length:
+            raise EngineError(
+                f"prompt length {len(prompt_ids)} exceeds max_input_length "
+                f"{self.cfg.max_input_length}")
+        if len(prompt_ids) == 0:
+            raise EngineError("empty prompt")
+        eff_max = min(params.max_tokens,
+                      self.cfg.max_cache_len - len(prompt_ids))
+        need = _ceil_div(len(prompt_ids) + eff_max, self.cfg.page_size)
+        if need > self._n_pages - 1:
+            raise EngineError(
+                f"request needs {need} KV pages but the pool only has "
+                f"{self._n_pages - 1} (kv_pool_tokens too small)")
+        banned_ids = self._banned_ids(params)
         stream = TokenStream(next(self._req_counter))
         req = _Request(stream=stream, prompt_ids=list(prompt_ids),
                        params=params, eff_max=eff_max,
@@ -883,26 +987,65 @@ class Engine:
         return self._pmax
 
     def _run(self) -> None:
+        from ..obs.tracing import record_stage
         gen = self._gen
         try:
             while not self._stopped.is_set() and self._gen == gen:
-                did_work = self._admit()
+                t0 = time.monotonic()
+                did_admit = did_work = self._admit()
                 self._guard_live()
+                t1 = time.monotonic()
                 # First tokens are harvested BEFORE enqueueing more decode
                 # rounds: on high-latency device links the D2H can serialize
                 # behind queued rounds, inflating TTFT by whole rounds.
+                did_hfirst = bool(self._pending_first)
                 if self._pending_first:
                     self._harvest_first()
                     did_work = True
                 self._guard_live()
+                t2 = time.monotonic()
+                did_dispatch = False
                 while (self._slots
                        and len(self._inflight) < self.cfg.dispatch_depth
                        and self._dispatch_round()):
-                    did_work = True
+                    did_dispatch = did_work = True
                 self._guard_live()
+                t3 = time.monotonic()
+                did_harvest = False
                 if self._inflight:
-                    self._harvest_round()
+                    # Admission priority: blocking on an in-flight round
+                    # while a new request waits adds a whole round of
+                    # latency to its TTFT. If the round isn't done yet
+                    # and there's admission work, loop back and admit
+                    # first — the harvest happens once the data is ready.
+                    ready = True
+                    if ((self._head is not None or not self._pending.empty())
+                            and self._free_slots):
+                        try:
+                            ready = bool(self._inflight[0][1].is_ready())
+                        except Exception:  # noqa: BLE001 — optional probe
+                            ready = True
+                    if ready:
+                        self._harvest_round()
+                        did_harvest = True
+                    else:
+                        # brief yield: re-check admission next iteration
+                        # without hot-spinning when it is page-blocked
+                        # (_wake is usually still set here, so sleep —
+                        # waiting on the set event would return at once)
+                        time.sleep(0.002)
                     did_work = True
+                t4 = time.monotonic()
+                # Only phases that did work: idle iterations would race a
+                # first-wins stage collector with meaningless ~0 values.
+                if did_admit:
+                    record_stage("loop_admit", t1 - t0)
+                if did_hfirst:
+                    record_stage("loop_hfirst", t2 - t1)
+                if did_dispatch:
+                    record_stage("loop_dispatch", t3 - t2)
+                if did_harvest:
+                    record_stage("loop_hround", t4 - t3)
                 if not did_work:
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()
@@ -950,30 +1093,50 @@ class Engine:
             row = np.zeros((self._pmax,), np.int32)
             row[:n_alloc] = req.pages
 
-            bucket = self._bucket_for(len(req.prompt_ids))
-            ids = req.prompt_ids + [0] * (bucket - len(req.prompt_ids))
-            tokens = jnp.asarray(np.asarray(ids, np.int32)[None, :])
-            length = jnp.int32(len(req.prompt_ids))
+            from ..obs.tracing import record_stage
+            record_stage("engine_admit_pickup",
+                         time.monotonic() - req.stream.submit_time)
+            t_dispatch = time.monotonic()
             banned_row = np.zeros((self.model_cfg.vocab_size,), bool)
             if req.banned_ids:
                 banned_row[req.banned_ids] = True
             banned = jnp.asarray(banned_row)
             key = jax.random.fold_in(self._base_key,
                                      next(self._step_counter) ^ sp.random_seed)
-            # ONE dispatch for prefill+sample+insert, with liveness
-            # re-checked before committing: reset() may have run while the
-            # program compiled, and a disowned thread must neither donate
-            # the rebuilt state nor overwrite it afterwards.
+            # ONE dispatch for (retrieve+assemble+)prefill+sample+insert,
+            # with liveness re-checked before committing: reset() may have
+            # run while the program compiled, and a disowned thread must
+            # neither donate the rebuilt state nor overwrite it afterwards.
             self._guard_live()
-            new_state, first_tok = self._prefill_insert(
-                self._state, self.params, tokens, length, jnp.int32(slot),
-                jnp.asarray(row), jnp.float32(sp.temperature),
-                jnp.int32(sp.top_k), jnp.float32(sp.top_p),
-                jnp.float32(sp.repetition_penalty), banned, key,
-                jnp.int32(req.eff_max - 1), jnp.bool_(not sp.ignore_eos),
-                req.greedy)
+            if req.rag is not None:
+                q_llm, q_len, q_enc = req.rag
+                fused = self._fused_rag
+                req.proj_pos = fused.spec.bucket  # device pos upper bound
+                new_state, first_tok = self._rag_jit(
+                    self._state, self.params, fused.enc_params,
+                    fused.corpus, jnp.asarray(q_enc), jnp.asarray(q_llm),
+                    jnp.int32(q_len), jnp.int32(slot), jnp.asarray(row),
+                    jnp.float32(sp.temperature), jnp.int32(sp.top_k),
+                    jnp.float32(sp.top_p),
+                    jnp.float32(sp.repetition_penalty), banned, key,
+                    jnp.int32(req.eff_max - 1), jnp.bool_(not sp.ignore_eos),
+                    req.greedy)
+            else:
+                bucket = self._bucket_for(len(req.prompt_ids))
+                ids = req.prompt_ids + [0] * (bucket - len(req.prompt_ids))
+                tokens = jnp.asarray(np.asarray(ids, np.int32)[None, :])
+                length = jnp.int32(len(req.prompt_ids))
+                new_state, first_tok = self._prefill_insert(
+                    self._state, self.params, tokens, length, jnp.int32(slot),
+                    jnp.asarray(row), jnp.float32(sp.temperature),
+                    jnp.int32(sp.top_k), jnp.float32(sp.top_p),
+                    jnp.float32(sp.repetition_penalty), banned, key,
+                    jnp.int32(req.eff_max - 1), jnp.bool_(not sp.ignore_eos),
+                    req.greedy)
             self._guard_live()
             self._state = new_state
+            record_stage("engine_admit_dispatch",
+                         time.monotonic() - t_dispatch)
             try:
                 # Start the device->host transfer of the first token now —
                 # by harvest time the value is usually host-side already
@@ -1036,9 +1199,18 @@ class Engine:
         return True
 
     def _harvest_first(self) -> None:
+        from ..obs.tracing import record_stage
         pending, self._pending_first = self._pending_first, []
         for req, first_tok in pending:
-            self._emit_token(req, int(np.asarray(first_tok)))
+            t0 = time.monotonic()
+            arr = np.asarray(first_tok)
+            record_stage("engine_first_readback", time.monotonic() - t0)
+            if arr.ndim == 0:
+                self._emit_token(req, int(arr))
+            else:
+                # Fused-RAG aux row: [first_token, prompt_len, top_ids...]
+                req.stream.source_ids = [int(x) for x in arr[2:]]
+                self._emit_token(req, int(arr[0]))
 
     def _harvest_round(self) -> None:
         members, toks_dev = self._inflight.popleft()

@@ -35,6 +35,17 @@ class LlamaConfig:
     # "dense" = every expert on every token, zero-gated (O(T*E), no drops).
     moe_impl: str = "sparse"
     moe_capacity_factor: float = 2.0
+    # GPT-Next/Nemotron architecture knobs (reference serves this family
+    # as its second ensemble, ensemble_models/gptnext/ + conversion via
+    # model_server/conversion/nemo.py:35-65):
+    #   norm: "rmsnorm" (llama) | "layernorm1p" (NeMo's zero-centered
+    #         LayerNorm: weights stored as w-1, applied as (1+w)*x_hat+b)
+    #   mlp:  "swiglu" (llama gated SiLU) | "squared_relu" (GPT-Next:
+    #         relu(x W_up)^2 W_down, no gate projection)
+    norm: str = "rmsnorm"
+    mlp: str = "swiglu"
+    attn_bias: bool = False   # biases on wq/wk/wv/wo
+    mlp_bias: bool = False    # biases on the MLP projections
 
     @property
     def q_dim(self) -> int:
@@ -76,6 +87,23 @@ MIXTRAL_8X7B = LlamaConfig(hidden_size=4096, intermediate_size=14336,
                            max_position_embeddings=32768,
                            num_experts=8, num_experts_per_tok=2)
 
+# GPT-Next / Nemotron-8B (the reference's second served family:
+# ensemble_models/gptnext/, docs/rag/support_matrix.md:14 sizing;
+# nemotron_config.yaml deployment). Rotary attention, zero-centered
+# LayerNorm, squared-ReLU non-gated MLP, untied embeddings, 256k
+# SentencePiece vocab.
+NEMOTRON_8B = LlamaConfig(vocab_size=256000, hidden_size=4096,
+                          intermediate_size=16384, num_layers=32,
+                          num_heads=32, num_kv_heads=32, head_dim=128,
+                          max_position_embeddings=4096,
+                          norm="layernorm1p", mlp="squared_relu",
+                          attn_bias=False, mlp_bias=False)
+GPTNEXT_TINY = LlamaConfig(vocab_size=512, hidden_size=128,
+                           intermediate_size=256, num_layers=2,
+                           num_heads=4, num_kv_heads=4, head_dim=32,
+                           max_position_embeddings=512,
+                           norm="layernorm1p", mlp="squared_relu")
+
 # Small geometries for tests/benchmarks on limited hardware.
 LLAMA_TINY = LlamaConfig(vocab_size=512, hidden_size=128, intermediate_size=352,
                          num_layers=2, num_heads=4, num_kv_heads=2, head_dim=32,
@@ -95,6 +123,8 @@ MODEL_REGISTRY: dict[str, LlamaConfig] = {
     "llama-2-70b-chat": LLAMA2_70B,
     "codellama-13b-instruct": CODELLAMA_13B,
     "mixtral-8x7b-instruct": MIXTRAL_8X7B,
+    "nemotron-8b-chat": NEMOTRON_8B,
+    "gptnext-tiny": GPTNEXT_TINY,
     "llama-tiny": LLAMA_TINY,
     "llama-1b": LLAMA_1B,
 }

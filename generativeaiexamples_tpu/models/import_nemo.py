@@ -104,24 +104,42 @@ def load_nemo_checkpoint(path: str, cfg: LlamaConfig,
         raise ModelLoadError(f"{nemo_path}: missing tensor {name!r}")
 
     L, F = cfg.num_layers, cfg.intermediate_size
-    acc: dict[str, list] = {k: [None] * L for k in
-                            ("attn_norm", "mlp_norm", "wq", "wk", "wv",
-                             "wo", "w_gate", "w_up", "w_down")}
+    gptnext = cfg.mlp == "squared_relu"
+    ln1p = cfg.norm == "layernorm1p"
+    keys = ["attn_norm", "mlp_norm", "wq", "wk", "wv", "wo",
+            "w_up", "w_down"]
+    if not gptnext:
+        keys.append("w_gate")
+    if ln1p:
+        keys += ["attn_norm_b", "mlp_norm_b"]
+    acc: dict[str, list] = {k: [None] * L for k in keys}
     for i in range(L):
         base = f"encoder.layers.{i}."
         acc["attn_norm"][i] = get(base + "input_layernorm.weight")
         acc["mlp_norm"][i] = get(base + "post_attention_layernorm.weight")
+        if ln1p:
+            acc["attn_norm_b"][i] = get(base + "input_layernorm.bias")
+            acc["mlp_norm_b"][i] = get(
+                base + "post_attention_layernorm.bias")
         q, k, v = _split_qkv(
             get(base + "self_attention.query_key_value.weight"), cfg)
         acc["wq"][i], acc["wk"][i], acc["wv"][i] = q, k, v
         acc["wo"][i] = get(base + "self_attention.dense.weight").T
         fused_mlp = get(base + "mlp.dense_h_to_4h.weight")
-        if fused_mlp.shape[0] != 2 * F:
-            raise ModelLoadError(
-                f"{nemo_path}: expected swiglu-fused dense_h_to_4h with "
-                f"{2 * F} rows, got {fused_mlp.shape[0]}")
-        acc["w_gate"][i] = fused_mlp[:F].T
-        acc["w_up"][i] = fused_mlp[F:].T
+        if gptnext:
+            # GPT-Next MLP is non-gated: h_to_4h has exactly F rows
+            if fused_mlp.shape[0] != F:
+                raise ModelLoadError(
+                    f"{nemo_path}: expected squared-relu dense_h_to_4h "
+                    f"with {F} rows, got {fused_mlp.shape[0]}")
+            acc["w_up"][i] = fused_mlp.T
+        else:
+            if fused_mlp.shape[0] != 2 * F:
+                raise ModelLoadError(
+                    f"{nemo_path}: expected swiglu-fused dense_h_to_4h "
+                    f"with {2 * F} rows, got {fused_mlp.shape[0]}")
+            acc["w_gate"][i] = fused_mlp[:F].T
+            acc["w_up"][i] = fused_mlp[F:].T
         acc["w_down"][i] = get(base + "mlp.dense_4h_to_h.weight").T
 
     layers = {k: jnp.asarray(np.stack(v), dtype) for k, v in acc.items()}
@@ -132,6 +150,9 @@ def load_nemo_checkpoint(path: str, cfg: LlamaConfig,
         "final_norm": jnp.asarray(
             get("encoder.final_layernorm.weight"), dtype),
     }
+    if ln1p:
+        params["final_norm_b"] = jnp.asarray(
+            get("encoder.final_layernorm.bias"), dtype)
     try:
         params["lm_head"] = jnp.asarray(get("output_layer.weight").T, dtype)
     except ModelLoadError:

@@ -23,3 +23,19 @@ def rmsnorm(x: jax.Array, weight: jax.Array, eps: float = 1e-5) -> jax.Array:
     var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
     y = xf * jax.lax.rsqrt(var + eps)
     return (y.astype(dtype) * weight.astype(dtype)).astype(dtype)
+
+
+def layernorm1p(x: jax.Array, weight: jax.Array, bias: jax.Array,
+                eps: float = 1e-5) -> jax.Array:
+    """NeMo's zero-centered LayerNorm (GPT-Next/Nemotron blocks):
+    ``y = (1 + w) * (x - mean) / sqrt(var + eps) + b`` — the weight is
+    stored centered at 0 so weight decay pulls toward identity
+    (reference family: model_server/conversion/nemo.py serves these
+    checkpoints; the math is NeMo megatron's ``layernorm1p``)."""
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    y = y * (1.0 + weight.astype(jnp.float32)) + bias.astype(jnp.float32)
+    return y.astype(dtype)

@@ -57,6 +57,14 @@ def llama_param_specs(cfg: LlamaConfig, mesh: Mesh) -> Specs:
         "wv": P(pp, None, kv_tp),
         "wo": P(pp, q_tp, None),
     }
+    # GPT-Next/Nemotron extras (norm biases, projection biases): biases
+    # shard like their projection's output dim.
+    if cfg.norm == "layernorm1p":
+        layers["attn_norm_b"] = P(pp, None)
+        layers["mlp_norm_b"] = P(pp, None)
+    if cfg.attn_bias:
+        layers.update({"bq": P(pp, q_tp), "bk": P(pp, kv_tp),
+                       "bv": P(pp, kv_tp), "bo": P(pp, None)})
     if cfg.num_experts:
         layers.update({
             "router": P(pp, None, None),
@@ -64,6 +72,13 @@ def llama_param_specs(cfg: LlamaConfig, mesh: Mesh) -> Specs:
             "w_up": P(pp, ep, None, tp),
             "w_down": P(pp, ep, tp, None),
         })
+    elif cfg.mlp == "squared_relu":
+        layers.update({
+            "w_up": P(pp, None, tp),
+            "w_down": P(pp, tp, None),
+        })
+        if cfg.mlp_bias:
+            layers.update({"b_up": P(pp, tp), "b_down": P(pp, None)})
     else:
         layers.update({
             "w_gate": P(pp, None, tp),
@@ -75,6 +90,8 @@ def llama_param_specs(cfg: LlamaConfig, mesh: Mesh) -> Specs:
         "layers": layers,
         "final_norm": P(None),
     }
+    if cfg.norm == "layernorm1p":
+        specs["final_norm_b"] = P(None)
     if not cfg.tie_word_embeddings:
         specs["lm_head"] = P(None, tp)
     return specs

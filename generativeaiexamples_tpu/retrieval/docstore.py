@@ -70,6 +70,25 @@ class DocumentIndex:
                                     id=hit.id, score=hit.score))
         return out
 
+    def get(self, doc_id: int):
+        """The stored Document for an id, or None."""
+        return self._docs.get(doc_id)
+
+    def export_corpus(self):
+        """(ids, embeddings (N, D), texts) of every live document — the
+        feed for the engine's device-resident fused-RAG corpus. None when
+        the backing store can't expose raw vectors (external servers)."""
+        export = getattr(self.store, "export_vectors", None)
+        if export is None:
+            return None
+        ids, emb = export()
+        keep = [(i, row) for i, row in zip(ids, emb) if i in self._docs]
+        if not keep:
+            return [], np.zeros((0, self.embedder.dim), np.float32), []
+        ids = [i for i, _ in keep]
+        emb = np.stack([row for _, row in keep])
+        return ids, emb, [self._docs[i].text for i in ids]
+
     def delete(self, ids: Sequence[int]) -> None:
         self.store.delete(ids)
         for i in ids:

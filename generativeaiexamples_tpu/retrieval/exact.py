@@ -78,6 +78,12 @@ class ExactStore(VectorStore):
                 self._deleted += 1
         self._tpu = None
 
+    def export_vectors(self) -> tuple[list[int], np.ndarray]:
+        """(ids, rows) of every live vector — feeds the engine's
+        device-resident fused-RAG corpus."""
+        live = [i for i in range(self._n) if self._live[i]]
+        return live, self._data[live].copy()
+
     def search(self, queries: np.ndarray, k: int = 4) -> list[list[SearchHit]]:
         q = _as_2d(queries)
         if self._n == 0:

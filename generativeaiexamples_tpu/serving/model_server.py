@@ -35,7 +35,10 @@ MODEL_TYPES = ("llama", "codellama", "gptnext", "mixtral", "dev")
 _TYPE_DEFAULT_NAME = {
     "llama": "llama-2-7b-chat",
     "codellama": "codellama-13b-instruct",
-    "gptnext": "llama-2-7b-chat",   # GPT-next geometry served via registry name
+    # Real GPT-Next architecture (layernorm1p + squared-ReLU MLP), not a
+    # llama alias: reference serves Nemotron as its second ensemble
+    # (ensemble_models/gptnext/, conversion via nemo.py:35-65).
+    "gptnext": "nemotron-8b-chat",
     "mixtral": "mixtral-8x7b-instruct",
     "dev": "llama-tiny",
 }

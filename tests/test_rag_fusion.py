@@ -1,0 +1,253 @@
+"""Fused on-device RAG admission (engine/rag_fusion.py).
+
+The retrieve->assemble->prefill chain runs as one XLA program inside the
+engine; these tests pin (a) the token-space prompt assembly against a
+numpy reference, (b) end-to-end fused generation incl. on-device top-k
+correctness, (c) the chain's auto-enable/fallback behavior.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from generativeaiexamples_tpu.embed.encoder import EmbeddingService
+from generativeaiexamples_tpu.engine import Engine, EngineConfig, SamplingParams
+from generativeaiexamples_tpu.engine.rag_fusion import (FusedRag,
+                                                        FusedRagSpec,
+                                                        build_prompt_parts,
+                                                        corpus_rows)
+from generativeaiexamples_tpu.models import encoder as enc_mod
+from generativeaiexamples_tpu.models import llama
+from generativeaiexamples_tpu.models.configs import ENCODER_TINY, LlamaConfig
+from generativeaiexamples_tpu.models.tokenizer import ByteTokenizer
+
+CFG = LlamaConfig(vocab_size=320, hidden_size=64, intermediate_size=128,
+                  num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+                  max_position_embeddings=1024)
+ENGINE_CFG = EngineConfig(max_slots=2, max_input_length=256,
+                          max_output_length=32, prefill_buckets=(128, 256),
+                          dtype="float32", kv_pool_tokens=1536,
+                          page_size=64, steps_per_round=4)
+
+
+def make_spec(**over):
+    base = dict(prefix_ids=(1, 10, 11), sep_ids=(12,), mid_ids=(13, 14),
+                suffix_ids=(15,), top_k=2, ctx_budget=40, bucket=128,
+                chunk_tokens=16, q_bucket=16, enc_bucket=32)
+    base.update(over)
+    return FusedRagSpec(**base)
+
+
+def make_encoder():
+    params = enc_mod.init_params(ENCODER_TINY, jax.random.key(3),
+                                 dtype=jnp.float32)
+    return params, ENCODER_TINY
+
+
+def encoder_qvec(enc_params, q_enc):
+    hidden = enc_mod.apply(enc_params, ENCODER_TINY, q_enc[0][None],
+                           q_enc[1][None])
+    return np.asarray(enc_mod.mean_pool(hidden, q_enc[1][None],
+                                        normalize=True)[0])
+
+
+def pack_query(ids, bucket):
+    q = np.zeros((2, bucket), np.int32)
+    q[0, :len(ids)] = ids
+    q[1, :len(ids)] = 1
+    return jnp.asarray(q)
+
+
+def reference_assembly(spec, doc_toks, doc_lens, order, q_ids):
+    """Numpy mirror of FusedRag.assemble's layout rules."""
+    out = list(spec.prefix_ids)
+    budget = spec.ctx_budget
+    used = 0
+    for rank, i in enumerate(order):
+        if doc_lens[i] == 0:
+            continue
+        cost = doc_lens[i] + (len(spec.sep_ids) if rank > 0 else 0)
+        if used + cost > budget:
+            break
+        if rank > 0:
+            out += list(spec.sep_ids)
+        out += list(doc_toks[i][:doc_lens[i]])
+        used += cost
+    out += list(spec.mid_ids)
+    out += list(q_ids)
+    out += list(spec.suffix_ids)
+    return out
+
+
+def test_assemble_matches_reference():
+    enc_params, enc_cfg = make_encoder()
+    spec = make_spec()
+    fused = FusedRag(enc_params, enc_cfg, spec)
+
+    rng = np.random.default_rng(0)
+    emb = rng.normal(size=(3, enc_cfg.hidden_size)).astype(np.float32)
+    doc_toks = np.zeros((3, spec.chunk_tokens), np.int32)
+    doc_lens = np.array([5, 16, 9], np.int32)
+    for i in range(3):
+        doc_toks[i, :doc_lens[i]] = 100 + 20 * i + np.arange(doc_lens[i])
+    fused.set_corpus(emb, doc_toks, doc_lens)
+
+    q_ids = [40, 41, 42]
+    q_enc = pack_query([7, 8, 9], spec.enc_bucket)
+    qvec = encoder_qvec(enc_params, q_enc)
+    scores = emb @ qvec
+    order = list(np.argsort(-scores)[:spec.top_k])
+
+    tokens, length, top_ids = jax.jit(fused.assemble)(
+        fused.enc_params, fused.corpus, q_enc,
+        jnp.asarray(np.pad(q_ids, (0, spec.q_bucket - len(q_ids)))),
+        jnp.int32(len(q_ids)))
+    tokens = np.asarray(tokens)
+    length = int(length)
+    assert list(np.asarray(top_ids)) == order
+    expected = reference_assembly(spec, doc_toks, doc_lens, order, q_ids)
+    assert length == len(expected)
+    np.testing.assert_array_equal(tokens[:length], expected)
+    assert not tokens[length:].any()
+
+
+def test_assemble_budget_cap():
+    """Docs that blow the context budget are dropped, keeping the
+    leading run (reference: LimitRetrievedNodesLength semantics)."""
+    enc_params, enc_cfg = make_encoder()
+    spec = make_spec(ctx_budget=18, top_k=3)
+    fused = FusedRag(enc_params, enc_cfg, spec)
+    emb = np.eye(3, enc_cfg.hidden_size, dtype=np.float32)
+    doc_toks = np.tile(np.arange(16, dtype=np.int32), (3, 1))
+    doc_lens = np.array([16, 16, 16], np.int32)
+    fused.set_corpus(emb, doc_toks, doc_lens)
+    q_enc = pack_query([5], spec.enc_bucket)
+    tokens, length, top_ids = jax.jit(fused.assemble)(
+        fused.enc_params, fused.corpus, q_enc,
+        jnp.zeros((spec.q_bucket,), jnp.int32), jnp.int32(1))
+    # only doc #1 fits (16 <= 18; adding sep+16 more would exceed)
+    qvec = encoder_qvec(enc_params, q_enc)
+    order = list(np.argsort(-(emb @ qvec))[:3])
+    expected = reference_assembly(spec, doc_toks, doc_lens, order, [0])
+    assert int(length) == len(expected)
+
+
+def build_engine():
+    params = llama.init_params(CFG, jax.random.key(0), dtype=jnp.float32)
+    return Engine(params, CFG, ByteTokenizer(), ENGINE_CFG)
+
+
+def test_fused_generation_end_to_end():
+    enc_params, enc_cfg = make_encoder()
+    eng = build_engine()
+    spec = make_spec(bucket=128, q_bucket=16)
+    eng.enable_fused_rag(enc_params, enc_cfg, spec)
+
+    # corpus whose top hit is forced: doc 1's embedding IS the query's
+    q_enc_ids = [7, 8, 9]
+    q_enc = pack_query(q_enc_ids, spec.enc_bucket)
+    qvec = encoder_qvec(enc_params, q_enc)
+    rng = np.random.default_rng(1)
+    emb = rng.normal(size=(4, enc_cfg.hidden_size)).astype(np.float32)
+    emb /= np.linalg.norm(emb, axis=1, keepdims=True) * 5  # weak noise
+    emb[1] = qvec
+    toks = np.zeros((4, spec.chunk_tokens), np.int32)
+    lens = np.full((4,), 6, np.int32)
+    for i in range(4):
+        toks[i, :6] = 50 + i
+    eng.set_rag_corpus(emb, toks, lens)
+
+    with eng:
+        stream = eng.submit_rag([30, 31], q_enc_ids, SamplingParams(
+            max_tokens=6, top_k=1, ignore_eos=True))
+        stream.text()
+    assert len(stream.token_ids) == 6
+    assert stream.finish_reason == "length"
+    assert len(stream.source_ids) == spec.top_k
+    assert stream.source_ids[0] == 1     # on-device top-k found the match
+
+
+def test_fused_and_plain_requests_coexist():
+    enc_params, enc_cfg = make_encoder()
+    eng = build_engine()
+    spec = make_spec()
+    eng.enable_fused_rag(enc_params, enc_cfg, spec)
+    emb = np.eye(2, enc_cfg.hidden_size, dtype=np.float32)
+    toks = np.ones((2, spec.chunk_tokens), np.int32)
+    lens = np.full((2,), 4, np.int32)
+    eng.set_rag_corpus(emb, toks, lens)
+    with eng:
+        s1 = eng.submit([5, 6, 7], SamplingParams(max_tokens=4, top_k=1,
+                                                  ignore_eos=True))
+        s2 = eng.submit_rag([30], [7], SamplingParams(max_tokens=4, top_k=1,
+                                                      ignore_eos=True))
+        s1.text()
+        s2.text()
+    assert len(s1.token_ids) == 4
+    assert len(s2.token_ids) == 4
+
+
+def test_corpus_regrow_recompiles():
+    enc_params, enc_cfg = make_encoder()
+    spec = make_spec()
+    fused = FusedRag(enc_params, enc_cfg, spec)
+    emb = np.eye(3, enc_cfg.hidden_size, dtype=np.float32)
+    toks = np.ones((3, spec.chunk_tokens), np.int32)
+    fused.set_corpus(emb, toks, np.full((3,), 2, np.int32))
+    assert fused.corpus["emb"].shape[0] == 8      # pow2 capacity
+    emb2 = np.eye(20, enc_cfg.hidden_size, dtype=np.float32)
+    toks2 = np.ones((20, spec.chunk_tokens), np.int32)
+    fused.set_corpus(emb2, toks2, np.full((20,), 2, np.int32))
+    assert fused.corpus["emb"].shape[0] == 32
+    assert int(fused.corpus["n"]) == 20
+
+
+def test_chain_auto_enables_and_falls_back(tmp_path):
+    """QAChatbot: fused turns on with an on-device embedder + engine LLM,
+    stays off with the hash embedder, and still answers either way."""
+    from generativeaiexamples_tpu.chains.examples.developer_rag import QAChatbot
+    from generativeaiexamples_tpu.chains.llm import EngineLLM
+    from generativeaiexamples_tpu.embed.encoder import HashEmbedder
+    from generativeaiexamples_tpu.utils.app_config import AppConfig
+    from generativeaiexamples_tpu.utils.configuration import from_dict
+
+    cfg = from_dict(AppConfig, {
+        "text_splitter": {"chunk_size": 24, "chunk_overlap": 4}})
+    doc = tmp_path / "d.txt"
+    doc.write_text("The MXU is a systolic array. " * 6)
+
+    # host-path prompts are byte-tokenized here, so give these engines a
+    # longer input ceiling than the fused-only fixtures
+    chain_cfg = EngineConfig(max_slots=2, max_input_length=768,
+                             max_output_length=32,
+                             prefill_buckets=(128, 768), dtype="float32",
+                             kv_pool_tokens=2048, page_size=64,
+                             steps_per_round=4)
+
+    def build_chain_engine():
+        params = llama.init_params(CFG, jax.random.key(0),
+                                   dtype=jnp.float32)
+        return Engine(params, CFG, ByteTokenizer(), chain_cfg)
+
+    enc_params, enc_cfg = make_encoder()
+    embedder = EmbeddingService(enc_params, enc_cfg, ByteTokenizer(),
+                                max_length=64, seq_buckets=(32, 64))
+    eng = build_chain_engine()
+    ex = QAChatbot(llm=EngineLLM(eng), embedder=embedder, config=cfg)
+    ex.ingest_docs(str(doc), "d.txt")
+    assert ex._fused_ready
+    out = "".join(ex.rag_chain("What is the MXU?", 4))
+    assert isinstance(out, str)
+    # fused source attribution maps on-device rows back to documents
+    assert ex.last_sources and ex.last_sources[0]["source"] == "d.txt"
+    eng.stop()
+
+    eng2 = build_chain_engine()
+    ex2 = QAChatbot(llm=EngineLLM(eng2), embedder=HashEmbedder(),
+                    config=cfg)
+    ex2.ingest_docs(str(doc), "d.txt")
+    assert not ex2._fused_ready          # hash embedder: host path
+    out2 = "".join(ex2.rag_chain("What is the MXU?", 4))
+    assert isinstance(out2, str)
+    eng2.stop()
