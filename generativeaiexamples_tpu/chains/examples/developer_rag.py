@@ -54,6 +54,7 @@ class QAChatbot(BaseExample):
             fused_rag = os.environ.get("GENAI_TPU_FUSED_RAG", "1") != "0"
         self._fused_requested = fused_rag
         self._fused_ready = False
+        self._fused_spec = None
         self._fused_sources: list[int] = []
 
     # ----------------------------------------------------------- ingestion
@@ -102,25 +103,35 @@ class QAChatbot(BaseExample):
             K = self.config.retriever.top_k
             ids, vecs, texts = data
             toks, lens = corpus_rows(texts, engine.tokenizer, C)
-            # Bucket sized to what retrieval can actually assemble from
-            # THIS corpus (k largest chunks + separators), not the
-            # worst-case config budget — the prompt bucket sets prefill
-            # FLOPs, which sit on the TTFT-critical path.
-            top_lens = sorted(int(n) for n in lens)[-K:]
+            # Bucket sized from the CONFIG worst case (k chunks at the
+            # splitter cap + separators), not from this corpus's actual
+            # chunk lengths: a corpus-derived bucket would shift as files
+            # arrive and recompile the fused admission program on every
+            # ingest. The config bound is stable, so the compile happens
+            # once; ingest only re-uploads the corpus arrays.
+            q_bucket = 64
             budget = min(self.config.retriever.max_context_tokens,
-                         sum(top_lens) + K * len(parts["sep_ids"]))
+                         K * (C + len(parts["sep_ids"])))
             overhead = (len(parts["prefix_ids"]) + len(parts["mid_ids"])
-                        + len(parts["suffix_ids"]) + 64)
+                        + len(parts["suffix_ids"]) + q_bucket)
             page = engine.cfg.page_size
             bucket = -(-(overhead + budget) // page) * page
             bucket = min(bucket, (engine.cfg.max_cache_len // page - 1)
                          * page)
+            # A clamped bucket must clamp the context budget with it, or
+            # assemble() would scatter the question past the bucket edge
+            # (mode='drop') and answer a question the model never saw.
+            budget = min(budget, bucket - overhead)
+            if budget <= 0:
+                logger.warning("fused RAG disabled: prompt bucket %d "
+                               "cannot hold template+question", bucket)
+                return
             spec = FusedRagSpec(**parts, top_k=K, ctx_budget=budget,
                                 bucket=bucket, chunk_tokens=C,
-                                q_bucket=64, enc_bucket=128)
-            if (engine._fused_rag is None
-                    or engine._fused_rag.spec != spec):
+                                q_bucket=q_bucket, enc_bucket=128)
+            if self._fused_spec != spec:
                 engine.enable_fused_rag(emb.params, emb.cfg, spec)
+                self._fused_spec = spec
             engine.set_rag_corpus(vecs, toks, lens)
             self._fused_doc_ids = ids
             self._fused_ready = True
@@ -140,11 +151,13 @@ class QAChatbot(BaseExample):
 
     def rag_chain(self, prompt: str, num_tokens: int,
                   ) -> Generator[str, None, None]:
-        spec = (self.llm.engine._fused_rag.spec
-                if self._fused_ready else None)
-        q_fits = spec is not None and len(self.llm.engine.tokenizer.encode(
-            prompt, add_bos=False)) <= spec.q_bucket
-        if self._fused_ready and q_fits:
+        # Attribution is per-request: clear before either path runs so
+        # last_sources never reports a previous answer's documents.
+        self._fused_sources = []
+        spec = self._fused_spec if self._fused_ready else None
+        q_ids = (self.llm.engine.tokenizer.encode(prompt, add_bos=False)
+                 if spec is not None else [])
+        if spec is not None and len(q_ids) <= spec.q_bucket:
             # Retrieval + prompt assembly + prefill fused into the
             # engine's admission program: one device dispatch, one
             # readback — the whole RAG hot path without host hops.
@@ -163,7 +176,8 @@ class QAChatbot(BaseExample):
             with event_span("llm", fused_rag=True, num_tokens=num_tokens):
                 yield from self.llm.stream_rag(
                     prompt, enc_ids, max_tokens=num_tokens,
-                    stop=["</s>", "[INST]"], on_sources=keep_sources)
+                    stop=["</s>", "[INST]"], on_sources=keep_sources,
+                    q_ids=q_ids)
             return
         # Child spans per pipeline stage — the retrieve/synthesize/llm
         # events the reference bridges out of LlamaIndex callbacks

@@ -90,13 +90,17 @@ class EngineLLM(LLM):
                    max_tokens: int = 256,
                    stop: Optional[list[str]] = None,
                    temperature: float = 1.0, top_k: int = 1,
-                   top_p: float = 0.0, on_sources=None) -> Iterator[str]:
+                   top_p: float = 0.0, on_sources=None,
+                   q_ids: Optional[list] = None) -> Iterator[str]:
         """Fused-RAG generation: retrieval + prompt assembly + prefill run
         as one device program inside the engine (engine/rag_fusion.py).
         ``enc_ids``: the question's tokens in the ENCODER vocabulary,
         query prefix included. ``on_sources`` (optional callable) receives
         the retrieved corpus row ids once they are known — the on-device
-        retrieval's answer to the host path's similarity_search result."""
+        retrieval's answer to the host path's similarity_search result.
+        ``q_ids``: the question pre-tokenized in the LLM vocab (callers
+        that already encoded it for a bucket check pass it to keep one
+        tokenization on the TTFT path)."""
         import time
 
         from ..engine.sampling_params import SamplingParams
@@ -105,7 +109,8 @@ class EngineLLM(LLM):
                                 temperature=temperature, top_k=top_k,
                                 top_p=top_p)
         self.engine.start()
-        q_ids = self.engine.tokenizer.encode(question, add_bos=False)
+        if q_ids is None:
+            q_ids = self.engine.tokenizer.encode(question, add_bos=False)
         stream = self.engine.submit_rag(q_ids, enc_ids, params)
         yield from self._consume(stream, time.monotonic(),
                                  on_sources=on_sources)

@@ -162,6 +162,11 @@ class _Request:
     generated: int = 0
     greedy: bool = False      # top_k==1 / temp<=0: argmax fast path
     banned_ids: list[int] = field(default_factory=list)
+    # Multi-token bad-words sequences (each a list of >=2 token ids):
+    # banned on-device by matching the tail of generated tokens against the
+    # sequence prefix and masking the completing token (the reference's
+    # to_word_list_format sequences, preprocessing/1/model.py:211).
+    bad_seqs: list[list[int]] = field(default_factory=list)
     # Fused-RAG payload (q_llm (Sq,) int32, q_llm_len, q_enc (2, Se)):
     # admission runs the on-device retrieve+assemble+prefill program.
     rag: Optional[tuple] = None
@@ -173,6 +178,13 @@ class _Request:
 
 class Engine:
     """Continuous-batching engine over one model + mesh."""
+
+    # Device-side multi-token bad-words table shape: up to MAX_BAD_SEQS
+    # sequences per request, each up to MAX_BAD_LEN tokens. Static caps so
+    # the decode round's match is a fixed (B, W, L) compare — growing them
+    # recompiles, it does not reallocate per request.
+    MAX_BAD_SEQS = 8
+    MAX_BAD_LEN = 8
 
     def __init__(self, params: llama.Params, model_cfg: LlamaConfig,
                  tokenizer: Tokenizer, cfg: EngineConfig = EngineConfig(),
@@ -275,6 +287,15 @@ class Engine:
             "rep_pen": jnp.ones((B,), jnp.float32),
             "seen": jnp.zeros((B, mcfg.vocab_size), bool),
             "banned": jnp.zeros((B, mcfg.vocab_size), bool),
+            # Multi-token bad-words: per-slot sequence table (padded with
+            # -1), per-sequence lengths, and a ring of the last L-1
+            # generated tokens the match runs against. -1 padding can never
+            # equal a real token id, so "not enough history yet" needs no
+            # separate mask.
+            "bad_seq": jnp.full((B, self.MAX_BAD_SEQS, self.MAX_BAD_LEN),
+                                -1, jnp.int32),
+            "bad_len": jnp.zeros((B, self.MAX_BAD_SEQS), jnp.int32),
+            "recent": jnp.full((B, self.MAX_BAD_LEN - 1), -1, jnp.int32),
         }
         if mesh is not None:
             cache_specs = paged_kv_cache_spec(mcfg, mesh)
@@ -557,8 +578,8 @@ class Engine:
             return cache["k"], cache["v"], first_tok, seen
 
         def insert(state, k_new, v_new, slot, length, first_tok,
-                   temp, top_k, top_p, rep_pen, seen, banned, row,
-                   remaining, eos_ok):
+                   temp, top_k, top_p, rep_pen, seen, banned,
+                   bad_seq, bad_len, row, remaining, eos_ok):
             """Scatter a prefilled bucket into the slot's pages and arm the
             slot. ``row``: (Pmax,) physical page per logical page, padded
             with 0 (trash) — bucket overhang beyond the allocated extent
@@ -594,6 +615,14 @@ class Engine:
                 "rep_pen": state["rep_pen"].at[slot].set(rep_pen),
                 "seen": state["seen"].at[slot].set(seen),
                 "banned": state["banned"].at[slot].set(banned),
+                "bad_seq": state["bad_seq"].at[slot].set(bad_seq),
+                "bad_len": state["bad_len"].at[slot].set(bad_len),
+                # Sequence matching runs over *generated* tokens only (the
+                # reference bans output occurrences): fresh ring, seeded
+                # with the first sampled token.
+                "recent": state["recent"].at[slot].set(
+                    jnp.full((self.MAX_BAD_LEN - 1,), -1, jnp.int32)
+                    .at[-1].set(first_tok)),
             }
 
         def make_round(window: int, steps: int, greedy: bool):
@@ -622,6 +651,31 @@ class Engine:
                     penalized = apply_repetition_penalty(
                         logits[:, 0], st["seen"], st["rep_pen"])
                     penalized = jnp.where(st["banned"], -1e30, penalized)
+                    # Multi-token bad-words: a sequence of length l is
+                    # banned by masking its LAST token whenever the l-1
+                    # most recent generated tokens equal its prefix. The
+                    # compare is (B, W, L) int32 — noise next to the
+                    # (B, V) vocab masks above.
+                    seq, slen = st["bad_seq"], st["recent"].shape[1]
+                    Lb = seq.shape[2]
+                    blen = st["bad_len"]
+                    j = jnp.arange(Lb, dtype=jnp.int32)
+                    # seq position j aligns with ring index Lb - l + j
+                    gi = jnp.clip(Lb - blen[..., None] + j, 0, slen - 1)
+                    hist = jnp.take_along_axis(
+                        jnp.broadcast_to(st["recent"][:, None, :],
+                                         (B, seq.shape[1], slen)),
+                        gi, axis=2)
+                    need = j[None, None, :] < (blen[..., None] - 1)
+                    hit = ((hist == seq) | ~need).all(-1) & (blen >= 2)
+                    tail = jnp.take_along_axis(
+                        seq, jnp.maximum(blen - 1, 0)[..., None],
+                        axis=2)[..., 0]
+                    penalized = penalized.at[
+                        jnp.arange(B)[:, None],
+                        jnp.where(hit, tail, 0)].min(
+                        jnp.where(hit, -1e30, jnp.inf).astype(
+                            penalized.dtype))
                     if greedy:
                         tok = jnp.argmax(penalized.astype(jnp.float32),
                                          axis=-1).astype(jnp.int32)
@@ -639,7 +693,12 @@ class Engine:
                         last_token=jnp.where(active, tok, st["last_token"]),
                         active=active & ~finished,
                         remaining=remaining,
-                        seen=st["seen"].at[jnp.arange(B), tok].max(active))
+                        seen=st["seen"].at[jnp.arange(B), tok].max(active),
+                        recent=jnp.where(
+                            active[:, None],
+                            jnp.concatenate([st["recent"][:, 1:],
+                                             tok[:, None]], axis=1),
+                            st["recent"]))
                     return new_st, emitted
 
                 state, toks = jax.lax.scan(body, state,
@@ -652,8 +711,8 @@ class Engine:
             return dict(state, active=state["active"].at[slot].set(False))
 
         def prefill_insert(state, params, tokens, length, slot, row,
-                           temp, top_k, top_p, rep_pen, banned, key,
-                           remaining, eos_ok, greedy: bool):
+                           temp, top_k, top_p, rep_pen, banned, bad_seq,
+                           bad_len, key, remaining, eos_ok, greedy: bool):
             """Admission as ONE dispatch: prefill + sample + scatter into
             the slot's pages. Separate prefill/insert programs put two
             program boundaries (and a bucket-KV hand-off) on the
@@ -664,10 +723,10 @@ class Engine:
                 banned, key, greedy)
             new_state = insert(state, k_new, v_new, slot, length, first_tok,
                                temp, top_k, top_p, rep_pen, seen, banned,
-                               row, remaining, eos_ok)
+                               bad_seq, bad_len, row, remaining, eos_ok)
             return new_state, first_tok
 
-        self._prefill_insert = jax.jit(prefill_insert, static_argnums=(14,),
+        self._prefill_insert = jax.jit(prefill_insert, static_argnums=(16,),
                                        donate_argnums=(0,))
         self._prefill_insert_raw = prefill_insert  # for fused-RAG composition
         self._release = jax.jit(release, donate_argnums=(0,))
@@ -803,32 +862,56 @@ class Engine:
 
     # ------------------------------------------------------------------ API
 
-    def _banned_ids(self, params: SamplingParams) -> list[int]:
+    def _compile_bad_words(
+            self, params: SamplingParams
+    ) -> tuple[list[int], list[list[int]]]:
+        """bad_words -> (single-token ids, multi-token sequences).
+
+        Single-token spellings go on the static (V,) vocab mask; words
+        that only exist as multi-token spellings become device-side
+        sequence bans (the reference's word-list tensors,
+        preprocessing/1/model.py:211 ``to_word_list_format``).
+        """
         banned_ids: list[int] = []
+        bad_seqs: list[list[int]] = []
         for word in params.bad_words:
             # Subword tokenizers give a word several single-token
             # spellings — word-initial (metaspace-prefixed, what encode
             # produces after its dummy prefix) and bare continuation —
             # ban every variant the vocab holds so none slips the mask.
             variants = set()
+            seqs: list[list[int]] = []
             for text in (word, " " + word):
-                ids = self.tokenizer.encode(text, add_bos=False)
+                ids = [int(i) for i in
+                       self.tokenizer.encode(text, add_bos=False)]
                 if len(ids) == 1:
-                    variants.add(int(ids[0]))
+                    variants.add(ids[0])
+                elif ids and ids not in seqs:
+                    seqs.append(ids)
             lookup = getattr(self.tokenizer, "piece_id", None)
             if lookup is not None:
                 for piece in (word, "▁" + word):
                     pid = lookup(piece)
                     if pid is not None:
                         variants.add(int(pid))
-            if not variants:
-                n = len(self.tokenizer.encode(word, add_bos=False))
+            if variants:
+                banned_ids.extend(sorted(variants))
+            elif seqs:
+                for seq in seqs:
+                    if len(seq) > self.MAX_BAD_LEN:
+                        raise EngineError(
+                            f"bad_words entry {word!r} tokenizes to "
+                            f"{len(seq)} tokens; the device-side sequence "
+                            f"ban supports up to {self.MAX_BAD_LEN}")
+                    bad_seqs.append(seq)
+            else:
                 raise EngineError(
-                    f"bad_words entry {word!r} tokenizes to {n} tokens; "
-                    "only single-token bans are supported (device-side "
-                    "sequence banning is not implemented)")
-            banned_ids.extend(variants)
-        return banned_ids
+                    f"bad_words entry {word!r} produced no tokens")
+        if len(bad_seqs) > self.MAX_BAD_SEQS:
+            raise EngineError(
+                f"{len(bad_seqs)} multi-token bad-word sequences; the "
+                f"device table holds {self.MAX_BAD_SEQS}")
+        return banned_ids, bad_seqs
 
     # -------------------------------------------------------- fused RAG
 
@@ -845,13 +928,14 @@ class Engine:
 
         def rag_admit(state, params, enc_params, corpus, q_enc, q_llm,
                       q_llm_len, slot, row, temp, top_k, top_p, rep_pen,
-                      banned, key, remaining, eos_ok, greedy: bool):
+                      banned, bad_seq, bad_len, key, remaining, eos_ok,
+                      greedy: bool):
             tokens, length, top_ids = fused.assemble(
                 enc_params, corpus, q_enc, q_llm, q_llm_len)
             new_state, first = self._prefill_insert_raw(
                 state, params, tokens[None, :], length, slot, row, temp,
-                top_k, top_p, rep_pen, banned, key, remaining, eos_ok,
-                greedy)
+                top_k, top_p, rep_pen, banned, bad_seq, bad_len, key,
+                remaining, eos_ok, greedy)
             # One readback for everything the host needs: token, real
             # prompt length, retrieved corpus rows.
             aux = jnp.concatenate([
@@ -859,7 +943,7 @@ class Engine:
             return new_state, aux
 
         self._fused_rag = fused
-        self._rag_jit = jax.jit(rag_admit, static_argnums=(17,),
+        self._rag_jit = jax.jit(rag_admit, static_argnums=(19,),
                                 donate_argnums=(0,))
 
     def set_rag_corpus(self, emb, toks, lens) -> None:
@@ -900,13 +984,21 @@ class Engine:
                       self.cfg.max_cache_len - spec.bucket)
         if eff_max < 1:
             raise EngineError("fused-RAG bucket leaves no room to decode")
+        need = _ceil_div(spec.bucket + eff_max, self.cfg.page_size)
+        if need > self._n_pages - 1:
+            # mirror submit(): an extent the pool can never hold must fail
+            # here — enqueued, _admit would skip it forever (silent hang)
+            raise EngineError(
+                f"fused-RAG request needs {need} KV pages but the pool "
+                f"only has {self._n_pages - 1} (kv_pool_tokens too small)")
+        banned_ids, bad_seqs = self._compile_bad_words(params)
         stream = TokenStream(next(self._req_counter))
         req = _Request(stream=stream, prompt_ids=[], params=params,
                        eff_max=eff_max, extent=spec.bucket + eff_max,
                        detok=IncrementalDetokenizer(self.tokenizer),
                        stop=StopChecker(params.stop_words),
                        greedy=(params.top_k == 1 or params.temperature <= 0),
-                       banned_ids=self._banned_ids(params),
+                       banned_ids=banned_ids, bad_seqs=bad_seqs,
                        rag=(q_llm, len(ids), q_enc))
         try:
             self._pending.put_nowait((req, params))
@@ -938,7 +1030,7 @@ class Engine:
             raise EngineError(
                 f"request needs {need} KV pages but the pool only has "
                 f"{self._n_pages - 1} (kv_pool_tokens too small)")
-        banned_ids = self._banned_ids(params)
+        banned_ids, bad_seqs = self._compile_bad_words(params)
         stream = TokenStream(next(self._req_counter))
         req = _Request(stream=stream, prompt_ids=list(prompt_ids),
                        params=params, eff_max=eff_max,
@@ -946,7 +1038,7 @@ class Engine:
                        detok=IncrementalDetokenizer(self.tokenizer),
                        stop=StopChecker(params.stop_words),
                        greedy=(params.top_k == 1 or params.temperature <= 0),
-                       banned_ids=banned_ids)
+                       banned_ids=banned_ids, bad_seqs=bad_seqs)
         try:
             self._pending.put_nowait((req, params))
         except queue.Full:
@@ -1101,6 +1193,14 @@ class Engine:
             if req.banned_ids:
                 banned_row[req.banned_ids] = True
             banned = jnp.asarray(banned_row)
+            seq_tbl = np.full((self.MAX_BAD_SEQS, self.MAX_BAD_LEN), -1,
+                              np.int32)
+            seq_len = np.zeros((self.MAX_BAD_SEQS,), np.int32)
+            for i, seq in enumerate(req.bad_seqs):
+                seq_tbl[i, :len(seq)] = seq
+                seq_len[i] = len(seq)
+            bad_seq = jnp.asarray(seq_tbl)
+            bad_len = jnp.asarray(seq_len)
             key = jax.random.fold_in(self._base_key,
                                      next(self._step_counter) ^ sp.random_seed)
             # ONE dispatch for (retrieve+assemble+)prefill+sample+insert,
@@ -1118,7 +1218,8 @@ class Engine:
                     jnp.int32(q_len), jnp.int32(slot), jnp.asarray(row),
                     jnp.float32(sp.temperature), jnp.int32(sp.top_k),
                     jnp.float32(sp.top_p),
-                    jnp.float32(sp.repetition_penalty), banned, key,
+                    jnp.float32(sp.repetition_penalty), banned, bad_seq,
+                    bad_len, key,
                     jnp.int32(req.eff_max - 1), jnp.bool_(not sp.ignore_eos),
                     req.greedy)
             else:
@@ -1130,7 +1231,8 @@ class Engine:
                     self._state, self.params, tokens, length, jnp.int32(slot),
                     jnp.asarray(row), jnp.float32(sp.temperature),
                     jnp.int32(sp.top_k), jnp.float32(sp.top_p),
-                    jnp.float32(sp.repetition_penalty), banned, key,
+                    jnp.float32(sp.repetition_penalty), banned, bad_seq,
+                    bad_len, key,
                     jnp.int32(req.eff_max - 1), jnp.bool_(not sp.ignore_eos),
                     req.greedy)
             self._guard_live()

@@ -105,6 +105,49 @@ def test_stop_words(engine):
         assert stream.finish_reason == "stop"
 
 
+def test_multi_token_bad_words_banned_mid_stream(engine):
+    """A multi-token bad-word sequence never appears in the output: the
+    device-side match bans the completing token whenever the generated
+    tail equals the sequence prefix (reference: to_word_list_format,
+    preprocessing/1/model.py:211)."""
+    prompt = engine.tokenizer.encode("sequence ban")
+    sp = SamplingParams(max_tokens=24, top_k=1, ignore_eos=True)
+    base = engine.submit(prompt, sp)
+    base.text()
+    toks = base.token_ids
+    # Ban the first adjacent pair the unbanned greedy run emits. The pair
+    # is injected at the _compile_bad_words seam (byte tokens over 0x7F
+    # have no single-character spelling to pass through bad_words=[...];
+    # the text->sequence mapping is covered by the over-cap test below
+    # and the gRPC single-token test).
+    pair = [toks[0], toks[1]]
+    orig = engine._compile_bad_words
+    engine._compile_bad_words = lambda p: ([], [pair])
+    try:
+        banned = engine.submit(prompt, sp)
+        banned.text()
+    finally:
+        engine._compile_bad_words = orig
+    got = banned.token_ids
+    assert pair not in [list(p) for p in zip(got, got[1:])]
+    # The ban is on the *sequence*, not its tokens: the first token of
+    # the pair stays reachable — greedy decode still opens with it and
+    # is only steered away from completing the phrase.
+    assert got[0] == pair[0] and got[1] != pair[1]
+    assert banned.finish_reason == "length"
+
+
+def test_bad_words_over_caps_rejected(engine):
+    long_word = "x" * (Engine.MAX_BAD_LEN + 1)
+    with pytest.raises(EngineError):
+        engine.submit(engine.tokenizer.encode("p"), SamplingParams(
+            max_tokens=4, bad_words=[long_word]))
+    many = [chr(ord("a") + i) + "y" for i in range(Engine.MAX_BAD_SEQS + 1)]
+    with pytest.raises(EngineError):
+        engine.submit(engine.tokenizer.encode("p"), SamplingParams(
+            max_tokens=4, bad_words=many))
+
+
 def test_oversized_prompt_rejected(engine):
     with pytest.raises(EngineError):
         engine.submit([5] * 100, SamplingParams())

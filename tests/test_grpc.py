@@ -82,7 +82,10 @@ def test_grpc_bad_words_single_token(served):
     assert banned_char not in out
 
 
-def test_grpc_bad_words_multi_token_rejected(served):
+def test_grpc_bad_words_over_cap_rejected(served):
+    """Multi-token bans are served device-side, but a sequence longer than
+    the engine's table (MAX_BAD_LEN) is rejected loudly, not truncated."""
     with pytest.raises(grpc.RpcError) as err:
-        served.generate("x", max_tokens=4, bad_words=["multi token phrase"])
+        served.generate("x", max_tokens=4,
+                        bad_words=["far too long a phrase to fit the table"])
     assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
