@@ -129,9 +129,12 @@ class QAChatbot(BaseExample):
             spec = FusedRagSpec(**parts, top_k=K, ctx_budget=budget,
                                 bucket=bucket, chunk_tokens=C,
                                 q_bucket=q_bucket, enc_bucket=128)
-            if self._fused_spec != spec:
+            # Compare against the ENGINE's compiled spec, not a local
+            # cache alone — a rebuilt engine has no fused program even if
+            # this chatbot saw the same spec before.
+            if engine.fused_rag_spec != spec:
                 engine.enable_fused_rag(emb.params, emb.cfg, spec)
-                self._fused_spec = spec
+            self._fused_spec = spec
             engine.set_rag_corpus(vecs, toks, lens)
             self._fused_doc_ids = ids
             self._fused_ready = True

@@ -894,17 +894,23 @@ class Engine:
                     pid = lookup(piece)
                     if pid is not None:
                         variants.add(int(pid))
-            if variants:
-                banned_ids.extend(sorted(variants))
-            elif seqs:
-                for seq in seqs:
-                    if len(seq) > self.MAX_BAD_LEN:
-                        raise EngineError(
-                            f"bad_words entry {word!r} tokenizes to "
-                            f"{len(seq)} tokens; the device-side sequence "
-                            f"ban supports up to {self.MAX_BAD_LEN}")
+            banned_ids.extend(sorted(variants))
+            # A word is banned in EVERY spelling (the reference's word
+            # list carries all of them): single-token variants go on the
+            # vocab mask AND multi-token spellings become sequence bans —
+            # a word with a one-piece " word" form can still surface via
+            # its split bare form after a quote or newline.
+            for seq in seqs:
+                if len(seq) > self.MAX_BAD_LEN:
+                    raise EngineError(
+                        f"bad_words entry {word!r} tokenizes to "
+                        f"{len(seq)} tokens; the device-side sequence "
+                        f"ban supports up to {self.MAX_BAD_LEN}")
+                if not any(t in variants for t in seq):
+                    # spellings whose pieces include an already-banned
+                    # variant can never complete anyway
                     bad_seqs.append(seq)
-            else:
+            if not variants and not seqs:
                 raise EngineError(
                     f"bad_words entry {word!r} produced no tokens")
         if len(bad_seqs) > self.MAX_BAD_SEQS:
@@ -945,6 +951,13 @@ class Engine:
         self._fused_rag = fused
         self._rag_jit = jax.jit(rag_admit, static_argnums=(19,),
                                 donate_argnums=(0,))
+
+    @property
+    def fused_rag_spec(self):
+        """Spec of the compiled fused-RAG admission program, or None when
+        fused RAG is not enabled (e.g. after an engine rebuild) — callers
+        cache specs and must compare against the ENGINE's truth."""
+        return self._fused_rag.spec if self._fused_rag is not None else None
 
     def set_rag_corpus(self, emb, toks, lens) -> None:
         """Upload/replace the device-resident retrieval corpus

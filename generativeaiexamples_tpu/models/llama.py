@@ -486,3 +486,69 @@ def apply(params: Params, cfg: LlamaConfig, tokens: jax.Array,
                                cfg.rms_norm_eps), new_cache
         return rmsnorm(h, params["final_norm"], cfg.rms_norm_eps), new_cache
     return unembed(params, cfg, h), new_cache
+
+
+def apply_sp(params: Params, cfg: LlamaConfig, tokens: jax.Array,
+             positions: jax.Array, mesh) -> jax.Array:
+    """Sequence-parallel long-context forward (ring attention).
+
+    Activations are sharded along the sequence axis over the mesh's ``sp``
+    axis — per-device activation memory shrinks by ``sp``, which is what
+    lets a prefill far beyond one chip's HBM run at all. Attention is
+    exact: KV blocks rotate around the ``sp`` ring with ``ppermute``
+    (one ICI hop per step, overlapped with compute) and combine via
+    online softmax (parallel/ring_attention.py). Everything else in the
+    layer — norms, projections, MLP — is per-token, so sequence sharding
+    passes through it untouched. Params are replicated across ``sp``
+    (and sharded over ``dp`` batch if present).
+
+    The reference has no long-context path to mirror (its TRT engines fix
+    max_input_len at build time, conversion_scripts/llama/build.py:96-105);
+    this is TPU-native surface. No KV cache is produced — the intended use
+    is long-document scoring/training and as the prefill leg of
+    long-context serving. tp/ep/pp must be 1 on this mesh (a dp×sp mesh);
+    composing sp with in-layer tp is future work and rejected loudly.
+
+    tokens/positions: (B, S) with S divisible by sp. Returns logits
+    (B, S, V) float32, sharded (dp, sp) like the inputs.
+    """
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.ring_attention import ring_gqa_attention
+
+    n_sp = int(mesh.shape.get("sp", 1))
+    if n_sp <= 1:
+        raise ValueError("apply_sp needs a mesh with sp > 1; use apply()")
+    for ax in ("tp", "ep", "pp"):
+        if int(mesh.shape.get(ax, 1)) != 1:
+            raise ValueError(
+                f"apply_sp shards only dp×sp; mesh has {ax}="
+                f"{mesh.shape[ax]} (compose sp with {ax} is not supported)")
+    S = tokens.shape[1]
+    if S % n_sp:
+        raise ValueError(f"sequence length {S} not divisible by sp={n_sp}")
+    inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta,
+                                cfg.rope_scaling_factor)
+    dp = "dp" if int(mesh.shape.get("dp", 1)) > 1 else None
+
+    def fwd(tokens_l, positions_l, params_l):
+        h = jnp.take(params_l["embed"], tokens_l, axis=0)
+
+        def attend(q, k, v):
+            return ring_gqa_attention(q, k, v, positions_l,
+                                      axis_name="sp", axis_size=n_sp), None
+
+        def body(h, lp):
+            h, _ = decoder_layer(h, lp, cfg, positions_l, inv_freq,
+                                 None, attend=attend)
+            return h, None
+
+        h, _ = jax.lax.scan(body, h, params_l["layers"])
+        return unembed(params_l, cfg, h)
+
+    seq_spec = P(dp, "sp")
+    return shard_map(fwd, mesh=mesh,
+                     in_specs=(seq_spec, seq_spec, P()),
+                     out_specs=P(dp, "sp", None),
+                     check_rep=False)(tokens, positions, params)

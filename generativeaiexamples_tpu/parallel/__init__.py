@@ -9,9 +9,10 @@ and XLA emits the collectives over ICI (DCN across hosts).
 """
 
 from .mesh import AXES, MeshPlan, make_mesh
+from .ring_attention import ring_gqa_attention
 from .sharding import (llama_param_specs, shard_params, kv_cache_spec,
                        paged_kv_cache_spec, activation_spec)
 
 __all__ = ["AXES", "MeshPlan", "make_mesh", "llama_param_specs",
            "shard_params", "kv_cache_spec", "paged_kv_cache_spec",
-           "activation_spec"]
+           "activation_spec", "ring_gqa_attention"]

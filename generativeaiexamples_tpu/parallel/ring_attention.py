@@ -1,0 +1,102 @@
+"""Ring attention: sequence-parallel exact attention for long context.
+
+Implements the ``sp`` mesh axis (parallel/mesh.py AXES). The reference
+stack has no long-context path at all — its TRT engines are built for a
+fixed max_input_len (reference: conversion_scripts/llama/build.py:96-105)
+— so this is TPU-first surface, designed the way the hardware wants it:
+
+- **Sequence sharding.** Q, K, V are sharded along the sequence axis over
+  the ``sp`` mesh axis; every device holds ``S / sp`` tokens. Activation
+  memory per device shrinks by ``sp``, which is what makes 128k+ token
+  prefill fit at all.
+- **KV rotation over ICI.** Each of the ``sp`` steps computes attention of
+  the local queries against the KV block currently held, then passes the
+  block to the next device with ``jax.lax.ppermute`` — a neighbor-to-
+  neighbor transfer that rides a single ICI hop per step (the collective
+  pattern of the Ring Attention construction). The ``ppermute`` for step
+  ``s+1`` is issued *before* step ``s``'s einsums so XLA's async
+  collectives overlap the transfer with the matmuls.
+- **Online softmax.** Blocks combine with the same running (max, sum,
+  acc) rescaling as the flash-style chunked path in ``ops/attention.py``
+  — results are exact, not approximate, and match ``gqa_attention`` to
+  float tolerance.
+- **Causality by absolute position.** Each query row carries its absolute
+  position; a visiting KV block knows its global key offset from the ring
+  step, so cross-shard causal masking needs no extra communication. A
+  fully-masked visiting block contributes exactly zero (the masked-exp
+  trick, not exp(NEG-NEG)).
+
+The plain causal ring wastes ~half the FLOPs to masking on early shards
+(every device runs the same einsum shapes; later global blocks are masked
+for earlier queries). That is the standard cost of the unpermuted layout;
+a zig-zag token permutation can recover it and composes with this kernel
+(permute tokens before sharding), but is not applied by default because it
+complicates position bookkeeping for callers.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import NEG_INF
+
+
+def ring_gqa_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                       q_positions: jax.Array, *, axis_name: str,
+                       axis_size: int, causal: bool = True) -> jax.Array:
+    """Exact GQA over sequence-sharded Q/K/V. Call inside ``shard_map``.
+
+    q:           (B, Sq, H,  hd) — local query shard
+    k, v:        (B, Sk, KV, hd) — local KV shard (rotates around the ring)
+    q_positions: (B, Sq) int32   — ABSOLUTE positions of the local queries
+    axis_name:   mesh axis to ring over (canonically ``"sp"``)
+    axis_size:   static size of that axis (ppermute needs the ring length
+                 at trace time; shard_map gives no static axis-size query)
+
+    Shards are assumed position-contiguous: ring rank ``r`` holds global
+    keys ``[r*Sk, (r+1)*Sk)`` — which is what sharding a (B, S, …) array
+    over its sequence axis with a PartitionSpec produces.
+    Returns (B, Sq, H, hd) in q's dtype.
+    """
+    B, Sq, H, hd = q.shape
+    Sk, KV = k.shape[1], k.shape[2]
+    G = H // KV
+    scale = 1.0 / (hd ** 0.5)
+    qr = q.reshape(B, Sq, KV, G, hd)
+    my = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    acc0 = jnp.zeros((B, KV, G, Sq, hd), jnp.float32)
+    m0 = jnp.full((B, KV, G, Sq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, KV, G, Sq, 1), jnp.float32)
+
+    def body(s, carry):
+        acc, m, l, kb, vb = carry
+        # Launch the rotation for the NEXT step first: the einsums below
+        # have no data dependence on it, so the ICI transfer overlaps the
+        # MXU work instead of serializing after it.
+        kb_next = jax.lax.ppermute(kb, axis_name, perm)
+        vb_next = jax.lax.ppermute(vb, axis_name, perm)
+        # After s rotations the block we hold originated at rank (my - s).
+        src = jax.lax.rem(my - s + axis_size, axis_size)
+        key_idx = src * Sk + jnp.arange(Sk, dtype=jnp.int32)
+        scores = jnp.einsum("bskgh,btkh->bkgst", qr, kb,
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            mask = key_idx[None, None, :] <= q_positions[:, :, None]
+        else:
+            mask = jnp.ones((B, Sq, Sk), dtype=bool)
+        maskb = mask[:, None, None, :, :]
+        scores = jnp.where(maskb, scores, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
+        p = jnp.where(maskb, jnp.exp(scores - m_new), 0.0)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jnp.einsum("bkgst,btkh->bkgsh", p, vb.astype(jnp.float32))
+        return acc * alpha + pv, m_new, l, kb_next, vb_next
+
+    acc, m, l, _, _ = jax.lax.fori_loop(0, axis_size, body,
+                                        (acc0, m0, l0, k, v))
+    out = acc / jnp.maximum(l, 1e-30)
+    return out.transpose(0, 3, 1, 2, 4).reshape(B, Sq, H, hd).astype(q.dtype)
