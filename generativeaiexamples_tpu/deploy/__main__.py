@@ -8,8 +8,11 @@ deploy/k8s-operator/kube-trailblazer/main.go):
   reconcile -f pipeline.yaml [--charts PATH] [--dry-run]
             One reconcile pass of a HelmPipeline manifest.
   watch     [--charts PATH] [--interval SECONDS]
-            Controller loop: poll HelmPipeline CRs via kubectl, reconcile
-            each (requeue-on-error comes free from the next tick).
+            Controller loop: stream HelmPipeline watch events from the
+            apiserver (``kubectl get --watch --output-watch-events``),
+            reconcile on ADDED/MODIFIED, drain on DELETED, with a full
+            list+reconcile resync every --interval seconds (requeue of
+            errored pipelines comes free from the resync).
   install-crd
             kubectl-apply the HelmPipeline CRD.
 """
@@ -58,19 +61,78 @@ def _cmd_reconcile(args) -> int:
     return 1 if result.error else 0
 
 
+def _resync(kube, op) -> None:
+    proc = kube._run(["get", "helmpipelines", "-A", "-o", "json"])
+    if proc.returncode != 0:
+        print(f"list helmpipelines failed: {proc.stderr.strip()}",
+              file=sys.stderr)
+        return
+    for item in json.loads(proc.stdout).get("items", []):
+        pipeline = HelmPipeline.from_manifest(item)
+        result = op.reconcile(pipeline)
+        if result.error:
+            print(f"reconcile {pipeline.name}: requeue ({result.error})",
+                  file=sys.stderr)
+
+
 def _cmd_watch(args) -> int:
+    import subprocess
+
+    from .kube import iter_json_stream
+
     kube = KubectlKube()
     op = PipelineOperator(kube, chart_search_path=args.charts)
     while True:
-        proc = kube._run(["get", "helmpipelines", "-A", "-o", "json"])
-        if proc.returncode == 0:
-            for item in json.loads(proc.stdout).get("items", []):
-                pipeline = HelmPipeline.from_manifest(item)
-                result = op.reconcile(pipeline)
-                if result.error:
-                    print(f"reconcile {pipeline.name}: requeue "
-                          f"({result.error})", file=sys.stderr)
-        time.sleep(args.interval)
+        # Full resync first (startup + every reconnect): catches CRs whose
+        # events were missed while the watch was down, and re-runs errored
+        # pipelines — the controller-runtime resync analogue.
+        _resync(kube, op)
+        deadline = time.time() + args.interval
+        proc = subprocess.Popen(
+            [kube.kubectl, "get", "helmpipelines", "-A", "--watch",
+             "--output-watch-events", "-o", "json"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+        # A quiet watch blocks in readline forever; the timer tears the
+        # session down at the resync deadline so the outer loop's full
+        # resync is never starved.
+        import threading
+        timer = threading.Timer(args.interval, proc.terminate)
+        timer.daemon = True
+        timer.start()
+        try:
+            def chunks():
+                while True:
+                    line = proc.stdout.readline()
+                    if not line:
+                        return
+                    yield line
+            for event in iter_json_stream(chunks()):
+                etype = event.get("type", "MODIFIED")
+                pipeline = HelmPipeline.from_manifest(
+                    event.get("object", {}))
+                if not pipeline.name:
+                    continue
+                if etype == "DELETED":
+                    n = op.delete(pipeline)
+                    print(f"deleted {pipeline.name}: drained {n} objects",
+                          file=sys.stderr)
+                else:
+                    result = op.reconcile(pipeline)
+                    if result.error:
+                        print(f"reconcile {pipeline.name}: requeue "
+                              f"({result.error})", file=sys.stderr)
+        finally:
+            timer.cancel()
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                # kubectl wedged past SIGTERM (dead TCP, uninterruptible
+                # I/O) — kill it rather than dying with it
+                proc.kill()
+                proc.wait(timeout=10)
+        # loop -> resync + fresh watch (also bounds a wedged kubectl)
+        time.sleep(max(0.0, deadline - time.time()))
 
 
 def _cmd_install_crd(args) -> int:

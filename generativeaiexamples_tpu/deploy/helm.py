@@ -11,9 +11,11 @@ supported subset is valid Helm syntax — the charts also render with real
   ``{{ .Chart.Name }}``, ``{{ .Chart.Version }}``
 - pipes: ``| default <literal>``, ``| quote``, ``| int``, ``| toYaml``,
   ``| nindent N``
-- blocks: ``{{- if <ref> }} ... {{- else }} ... {{- end }}`` (nestable,
-  truthiness like Helm: absent/None/False/0/""/empty map are false)
+- blocks: ``{{- if <ref> }} ... {{- else }} ... {{- end }}`` and
+  ``{{- if not <ref> }}`` (nestable, truthiness like Helm:
+  absent/None/False/0/""/empty map are false)
 - ``{{- range .Values.list }}`` with ``{{ . }}`` for the element
+- ``{{- fail "message" }}`` aborts the render (value validation)
 
 Charts live as plain directories: ``Chart.yaml``, ``values.yaml``,
 ``templates/*.yaml``.
@@ -178,6 +180,10 @@ def _parse(src: str) -> list[_Block]:
             b = _Block("range", tag[6:].strip())
             emit(b)
             stack.append(b)
+        elif tag.startswith("fail "):
+            # helm's fail: abort the whole render with a message (used to
+            # refuse insecure value combinations at template time)
+            emit(_Block("fail", str(yaml.safe_load(tag[5:].strip()))))
         elif tag == "end":
             if not stack:
                 raise ChartError("'end' without open block")
@@ -205,10 +211,17 @@ def _render_blocks(blocks: list[_Block], ctx: dict) -> str:
         elif b.kind == "expr":
             out.append(str(_eval_expr(b.payload, ctx)))
         elif b.kind == "if":
-            cond = _lookup(ctx, b.payload) if b.payload.startswith(".") \
-                else yaml.safe_load(b.payload)
-            branch = b.children if _truthy(cond) else b.alt
+            expr = b.payload
+            negate = expr.startswith("not ")
+            if negate:
+                expr = expr[4:].strip()
+            cond = _lookup(ctx, expr) if expr.startswith(".") \
+                else yaml.safe_load(expr)
+            truthy = _truthy(cond) ^ negate
+            branch = b.children if truthy else b.alt
             out.append(_render_blocks(branch, ctx))
+        elif b.kind == "fail":
+            raise ChartError(f"fail: {b.payload}")
         elif b.kind == "range":
             items = _lookup(ctx, b.payload)
             if items is _SENTINEL or items is None:

@@ -13,7 +13,10 @@ Reconcile semantics match the reference controller
   are pruned; deleting a pipeline drains every owned object, workloads
   first (reference: controllers/helmpipeline_controller.go:75-94);
 - any package error aborts the walk and returns requeue=True
-  (reference: helmpipeline_controller.go:104-107).
+  (reference: helmpipeline_controller.go:104-107);
+- the outcome is written to the CR's ``status`` subresource — per-release
+  phase, observedGeneration, and a Ready condition — so ``kubectl get``
+  shows reconcile state the way the reference's controller reports it.
 """
 
 from __future__ import annotations
@@ -29,7 +32,8 @@ from urllib.parse import urlparse
 from .helm import ChartError, load_chart, render_chart
 from .kube import (KubeInterface, drain_order, ensure_labels, key_str,
                    obj_key, parse_key)
-from .types import OWNED_BY_LABEL, HelmPipeline, ReleaseState
+from .types import (API_VERSION, KIND, OWNED_BY_LABEL, HelmPipeline,
+                    ReleaseState)
 
 logger = logging.getLogger("tpu-rag.operator")
 
@@ -134,7 +138,52 @@ class PipelineOperator:
                 result.error = f"{pkg.release}: {exc}"
                 break
         self._save_state(pipeline, state)
+        self._write_status(pipeline, state, result)
         return result
+
+    def _write_status(self, pipeline: HelmPipeline,
+                      state: dict[str, ReleaseState],
+                      result: ReconcileResult) -> None:
+        """Report the pass on the CR's status subresource. Best-effort:
+        a status write must never fail the reconcile itself (the CR may
+        be racing deletion)."""
+        releases = {}
+        for pkg in pipeline.packages:
+            if pkg.release in result.installed:
+                phase = "installed"
+            elif pkg.release in result.skipped:
+                phase = "unchanged"
+            elif result.error and result.error.startswith(
+                    f"{pkg.release}:"):
+                phase = "error"
+            else:
+                phase = "pending"  # after the aborting release
+            entry = {"phase": phase}
+            st = state.get(pkg.release)
+            if st is not None:
+                entry["chart"] = st.chart
+                entry["version"] = st.version
+                entry["objects"] = len(st.object_keys)
+            releases[pkg.release] = entry
+        ready = result.error is None
+        status = {
+            "observedGeneration": pipeline.generation,
+            "releases": releases,
+            "conditions": [{
+                "type": "Ready",
+                "status": "True" if ready else "False",
+                "reason": "Reconciled" if ready else "ReconcileError",
+                "message": result.error or
+                f"{len(result.installed)} installed, "
+                f"{len(result.skipped)} unchanged",
+            }],
+        }
+        try:
+            self.kube.update_status(
+                (API_VERSION, KIND, pipeline.namespace, pipeline.name),
+                status)
+        except Exception:  # noqa: BLE001 — reporting must not break reconcile
+            logger.exception("status write failed for %s", pipeline.name)
 
     def delete(self, pipeline: HelmPipeline) -> int:
         """Drain every object owned by this pipeline (workloads first).

@@ -901,15 +901,19 @@ class Engine:
             # a word with a one-piece " word" form can still surface via
             # its split bare form after a quote or newline.
             for seq in seqs:
+                if any(t in variants for t in seq):
+                    # spellings whose pieces include an already-banned
+                    # variant can never complete anyway — and must not
+                    # trip the length cap below (a word whose ' word'
+                    # form is one banned piece stays servable however
+                    # long its split spelling is)
+                    continue
                 if len(seq) > self.MAX_BAD_LEN:
                     raise EngineError(
                         f"bad_words entry {word!r} tokenizes to "
                         f"{len(seq)} tokens; the device-side sequence "
                         f"ban supports up to {self.MAX_BAD_LEN}")
-                if not any(t in variants for t in seq):
-                    # spellings whose pieces include an already-banned
-                    # variant can never complete anyway
-                    bad_seqs.append(seq)
+                bad_seqs.append(seq)
             if not variants and not seqs:
                 raise EngineError(
                     f"bad_words entry {word!r} produced no tokens")

@@ -35,7 +35,11 @@ class SamplingParams:
 
     def __post_init__(self) -> None:
         if self.beam_width != 1:
-            raise ValueError("beam_width != 1 is not supported")
+            raise ValueError(
+                "beam_width != 1 is not supported: beam search is a "
+                "declared non-goal of this stack (docs/support-matrix.md) "
+                "— it multiplies decode HBM traffic by the beam width for "
+                "quality current-generation chat models get from sampling")
         if self.max_tokens < 1:
             raise ValueError("max_tokens must be >= 1")
         if self.length_penalty != 1.0:

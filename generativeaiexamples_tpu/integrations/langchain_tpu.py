@@ -171,6 +171,76 @@ class TpuLLM(_LCLLM):
             yield chunk
 
 
+class TpuJobsLLM(_LCLLM):
+    """LangChain LLM over the async job API (submit-then-poll).
+
+    The client-side counterpart of the reference's cloud-function
+    connector (nv_aiplay.py:222-316): generation goes through
+    POST /v1/jobs + 202 polling via ``serving.client.JobsClient``, which
+    survives load-balancer/request timeouts that kill a streaming call.
+    ``model_name`` resolves against the server's /v1/models registry
+    with exact-then-substring matching, as the reference resolves NVCF
+    function names. No token streaming — per-chunk delivery is what the
+    job API exists to avoid; use ``TpuLLM`` for streaming.
+    """
+
+    server_url: str = ""
+    model_name: str = ""             # "" = server default; else resolved
+    temperature: float = 1.0
+    top_p: float = 0.0
+    top_k: int = 1
+    tokens: int = 100
+    timeout: float = 300.0
+    poll_interval: float = 0.25
+
+    model_config = {"arbitrary_types_allowed": True, "extra": "allow"}
+
+    @property
+    def _llm_type(self) -> str:
+        return "tpu_jobs_llm"
+
+    @property
+    def _identifying_params(self) -> dict:
+        return {"server_url": self.server_url,
+                "model_name": self.model_name}
+
+    def _client(self):
+        client = getattr(self, "_jobs_client", None)
+        if client is None:
+            from ..serving.client import JobsClient
+            client = JobsClient(self.server_url, timeout=self.timeout,
+                                poll_interval=self.poll_interval)
+            if self.model_name:
+                # resolve against the GENERATION entries only (the
+                # registry also lists the embeddings pseudo-model) and
+                # remember the result — it is sent with every job
+                models = {k: v for k, v in client.available_models().items()
+                          if k != "embeddings"}
+                name = self.model_name
+                resolved = name if name in models else next(
+                    (k for k in sorted(models) if name in k), None)
+                if resolved is None:
+                    raise ValueError(
+                        f"unknown model name {name!r}; server has "
+                        f"{sorted(models)}")
+                object.__setattr__(self, "_resolved_model", resolved)
+            object.__setattr__(self, "_jobs_client", client)
+        return client
+
+    def _call(self, prompt: str, stop: Optional[List[str]] = None,
+              run_manager: Optional[CallbackManagerForLLMRun] = None,
+              **kwargs: Any) -> str:
+        client = self._client()
+        params = {"max_tokens": self.tokens, "temperature": self.temperature,
+                  "top_k": self.top_k, "top_p": self.top_p, **kwargs}
+        resolved = getattr(self, "_resolved_model", "")
+        if resolved:
+            params["model"] = resolved
+        if stop is not None:
+            params["stop"] = list(stop)
+        return client.generate(prompt, **params)
+
+
 class TpuEmbeddings(_LCEmbeddings):
     """LangChain Embeddings over the stack's encoder endpoints, with the
     passage/query input-type split of the reference's NeMo embedder

@@ -32,10 +32,13 @@ from ..utils.errors import ShardingError
 
 def pipeline_forward(mesh: Mesh, params: llama.Params, cfg: LlamaConfig,
                      tokens: jax.Array, positions: jax.Array,
-                     n_microbatches: int = 2) -> jax.Array:
+                     n_microbatches: int = 2,
+                     kv_valid_len: jax.Array | None = None) -> jax.Array:
     """Forward pass with the layer stack pipelined over the ``pp`` axis.
 
     tokens/positions: (B, S); B must divide into ``n_microbatches``.
+    kv_valid_len: optional (B,) valid-token count per row (padding mask
+    for attention), sliced per microbatch like the tokens.
     Embedding and the output head are replicated across stages (they are
     small next to the layer stack); only stage 0 consumes the embedding and
     only the last stage's logits survive. Returns (B, S, V) float32 logits,
@@ -53,7 +56,7 @@ def pipeline_forward(mesh: Mesh, params: llama.Params, cfg: LlamaConfig,
                             f"n_microbatches={M}")
     mb = B // M
 
-    def stage_fn(layers, embed, tokens, positions):
+    def stage_fn(layers, embed, tokens, positions, valid):
         stage = jax.lax.axis_index("pp")
         is_first = stage == 0
         is_last = stage == pp - 1
@@ -65,8 +68,10 @@ def pipeline_forward(mesh: Mesh, params: llama.Params, cfg: LlamaConfig,
             idx = jnp.clip(my_mb, 0, M - 1) * mb
             tok_mb = jax.lax.dynamic_slice(tokens, (idx, 0), (mb, S))
             pos_mb = jax.lax.dynamic_slice(positions, (idx, 0), (mb, S))
+            val_mb = jax.lax.dynamic_slice(valid, (idx,), (mb,))
             h_in = jnp.where(is_first, jnp.take(embed, tok_mb, axis=0), recv)
-            h_out = llama.run_layers(layers, cfg, h_in, pos_mb)
+            h_out = llama.run_layers(layers, cfg, h_in, pos_mb,
+                                     kv_valid_len=val_mb)
             # the last stage commits hidden states for its (valid)
             # microbatch; others re-write what is already there
             current = jax.lax.dynamic_slice(outbuf, (idx, 0, 0), h_out.shape)
@@ -92,12 +97,17 @@ def pipeline_forward(mesh: Mesh, params: llama.Params, cfg: LlamaConfig,
         return jax.lax.psum(
             jnp.where(is_last, outbuf, jnp.zeros_like(outbuf)), "pp")
 
+    if kv_valid_len is None:
+        # every position valid: same in-sequence causal masking as the
+        # unpipelined forward's default
+        kv_valid_len = jnp.full((B,), S, jnp.int32)
     layer_specs = jax.tree.map(lambda _: P("pp"), params["layers"])
     hidden = jax.shard_map(
         stage_fn, mesh=mesh,
-        in_specs=(layer_specs, P(), P(), P()),
+        in_specs=(layer_specs, P(), P(), P(), P()),
         out_specs=P())(
-        params["layers"], params["embed"], tokens, positions)
+        params["layers"], params["embed"], tokens, positions,
+        kv_valid_len.astype(jnp.int32))
     # unembed once, outside the pipeline (head weights are pp-replicated)
     return llama.unembed(params, cfg, hidden)
 
@@ -110,7 +120,14 @@ def pipeline_loss_fn(mesh: Mesh, cfg: LlamaConfig, n_microbatches: int = 2):
     def loss_fn(params, batch):
         B, S = batch["tokens"].shape
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
-        logits = fwd(params, cfg, batch["tokens"], positions)
+        # same contract as the pp==1 branch: "mask" is the LOSS mask,
+        # attention validity comes from "length" when provided (SFT
+        # batches mask prompt tokens out of the loss but not attention)
+        length = batch.get("length")
+        if length is None:
+            length = jnp.sum(batch["mask"], axis=-1)
+        logits = fwd(params, cfg, batch["tokens"], positions,
+                     kv_valid_len=length)
         logp = jax.nn.log_softmax(logits, axis=-1)
         nll = -jnp.take_along_axis(
             logp, batch["targets"][..., None].astype(jnp.int32), axis=-1

@@ -71,13 +71,7 @@ def ring_gqa_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     m0 = jnp.full((B, KV, G, Sq, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((B, KV, G, Sq, 1), jnp.float32)
 
-    def body(s, carry):
-        acc, m, l, kb, vb = carry
-        # Launch the rotation for the NEXT step first: the einsums below
-        # have no data dependence on it, so the ICI transfer overlaps the
-        # MXU work instead of serializing after it.
-        kb_next = jax.lax.ppermute(kb, axis_name, perm)
-        vb_next = jax.lax.ppermute(vb, axis_name, perm)
+    def block_update(s, acc, m, l, kb, vb):
         # After s rotations the block we hold originated at rank (my - s).
         src = jax.lax.rem(my - s + axis_size, axis_size)
         key_idx = src * Sk + jnp.arange(Sk, dtype=jnp.int32)
@@ -94,9 +88,24 @@ def ring_gqa_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         alpha = jnp.exp(m - m_new)
         l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
         pv = jnp.einsum("bkgst,btkh->bkgsh", p, vb.astype(jnp.float32))
-        return acc * alpha + pv, m_new, l, kb_next, vb_next
+        return acc * alpha + pv, m_new, l
 
-    acc, m, l, _, _ = jax.lax.fori_loop(0, axis_size, body,
-                                        (acc0, m0, l0, k, v))
+    def body(s, carry):
+        acc, m, l, kb, vb = carry
+        # Launch the rotation for the NEXT step first: the einsums below
+        # have no data dependence on it, so the ICI transfer overlaps the
+        # MXU work instead of serializing after it.
+        kb_next = jax.lax.ppermute(kb, axis_name, perm)
+        vb_next = jax.lax.ppermute(vb, axis_name, perm)
+        acc, m, l = block_update(s, acc, m, l, kb, vb)
+        return acc, m, l, kb_next, vb_next
+
+    # The loop runs axis_size-1 steps (each rotates); the LAST block is
+    # consumed outside it with no trailing ppermute — rotating blocks
+    # nobody will read is pure wasted ICI traffic (1/axis_size of the
+    # total per layer).
+    acc, m, l, kb, vb = jax.lax.fori_loop(0, axis_size - 1, body,
+                                          (acc0, m0, l0, k, v))
+    acc, m, l = block_update(axis_size - 1, acc, m, l, kb, vb)
     out = acc / jnp.maximum(l, 1e-30)
     return out.transpose(0, 3, 1, 2, 4).reshape(B, Sq, H, hd).astype(q.dtype)

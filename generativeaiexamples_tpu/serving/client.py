@@ -178,3 +178,25 @@ class JobsClient:
     def cancel(self, job_id: str) -> None:
         import requests
         requests.delete(f"{self.base}/v1/jobs/{job_id}", timeout=30)
+
+    def available_models(self) -> dict:
+        """{model name: entry} from the server's model registry — the
+        reference connector's ``get_available_models``
+        (nv_aiplay.py:287-292 filters the NVCF function list)."""
+        import requests
+        resp = requests.get(f"{self.base}/v1/models", timeout=30)
+        resp.raise_for_status()
+        return {e["id"]: e for e in resp.json().get("data", [])}
+
+    def resolve_model(self, name: str) -> str:
+        """Exact-then-substring model-name resolution, as the reference's
+        ``_get_invoke_url`` (nv_aiplay.py:296-308): 'llama' finds
+        'llama-2-7b-chat'. Raises on no match."""
+        models = self.available_models()
+        if name in models:
+            return name
+        for key in sorted(models):
+            if name in key:
+                return key
+        raise ValueError(f"unknown model name {name!r}; server has "
+                         f"{sorted(models)}")

@@ -86,6 +86,13 @@ def add_jobs_routes(app: web.Application, engine, model_name: str,
         prompt = str(body.get("prompt", ""))
         if not prompt:
             raise web.HTTPUnprocessableEntity(text="'prompt' is required")
+        req_model = str(body.get("model", ""))
+        if req_model and req_model != model_name:
+            # a resolved-but-wrong model must fail loudly, not silently
+            # generate with whatever this server happens to serve
+            raise web.HTTPNotFound(
+                text=f"model {req_model!r} is not served here "
+                     f"(serving {model_name!r})")
         try:
             params = _sampling_from_body(body, max_output)
             engine.start()

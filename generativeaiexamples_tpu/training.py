@@ -32,7 +32,12 @@ def make_train_step(cfg: LlamaConfig, optimizer: optax.GradientTransformation,
                     mesh=None, n_microbatches: int = 2):
     """Build a (params, opt_state, batch) -> (params, opt_state, loss) step.
 
-    ``batch`` = {"tokens": (B,S), "targets": (B,S), "mask": (B,S)}.
+    ``batch`` = {"tokens": (B,S), "targets": (B,S), "mask": (B,S)} plus an
+    optional ``"length"`` (B,). ``mask`` is the LOSS mask; attention
+    validity defaults to ``sum(mask)`` (right-padded plain-LM batches)
+    but an SFT batch that masks prompt tokens OUT of the loss must pass
+    the true per-row token count as ``length`` — otherwise the masked
+    prompt would also vanish from attention.
     jit it with shardings from ``parallel.llama_param_specs`` to train over
     a mesh; XLA inserts the gradient all-reduces over dp and the TP
     collectives over tp. When ``mesh`` has pp > 1 the forward runs the
@@ -49,9 +54,12 @@ def make_train_step(cfg: LlamaConfig, optimizer: optax.GradientTransformation,
             B, S = batch["tokens"].shape
             positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32),
                                          (B, S))
+            length = batch.get("length")
+            if length is None:
+                length = jnp.sum(batch["mask"], axis=-1)
             logits, _ = llama.apply(
                 params, cfg, batch["tokens"], positions,
-                kv_valid_len=jnp.sum(batch["mask"], axis=-1))
+                kv_valid_len=length)
             return cross_entropy_loss(logits, batch["targets"],
                                       batch["mask"])
 

@@ -17,10 +17,13 @@ import yaml
 from generativeaiexamples_tpu.deploy.helm import (Chart, ChartError,
                                                   deep_merge, load_chart,
                                                   render_chart)
-from generativeaiexamples_tpu.deploy.kube import (InMemoryKube, drain_order,
-                                                  obj_key)
+from generativeaiexamples_tpu.deploy.kube import (ConflictError,
+                                                  InMemoryKube,
+                                                  RejectedError, drain_order,
+                                                  iter_json_stream, obj_key)
 from generativeaiexamples_tpu.deploy.operator import PipelineOperator
-from generativeaiexamples_tpu.deploy.types import (OWNED_BY_LABEL,
+from generativeaiexamples_tpu.deploy.types import (API_VERSION, KIND,
+                                                   OWNED_BY_LABEL,
                                                    HelmPackage, HelmPipeline)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -114,6 +117,23 @@ def test_chart_golden_render(name, expected_kinds):
     assert json.loads(json.dumps(objs, sort_keys=True)) == golden
 
 
+def test_jupyter_requires_token():
+    """jupyter.enabled without a token must refuse to render — an
+    unauthenticated NodePort JupyterLab is remote code execution."""
+    chart = load_chart(os.path.join(CHARTS, "rag-llm-pipeline"))
+    with pytest.raises(ChartError, match="jupyter.token"):
+        render_chart(chart, "r", "ns", values={"jupyter": {"enabled": True}})
+    objs = render_chart(chart, "r", "ns", values={
+        "jupyter": {"enabled": True, "token": "s3cret"}})
+    jup = [o for o in objs if "jupyter" in o["metadata"]["name"]]
+    assert {o["kind"] for o in jup} == {"Deployment", "Service"}
+    args = jup[0]["spec"]["template"]["spec"]["containers"][0]["args"]
+    assert "--NotebookApp.token=s3cret" in args
+    # disabled by default
+    assert not any("jupyter" in o["metadata"]["name"]
+                   for o in render_chart(chart, "r", "ns"))
+
+
 def test_chart_values_toggle_components():
     chart = load_chart(os.path.join(CHARTS, "rag-llm-pipeline"))
     full = render_chart(chart, "r", "ns")
@@ -165,9 +185,11 @@ def test_reconcile_unchanged_release_is_skipped():
     n_events = len(kube.events)
     result = op.reconcile(_pipeline())
     assert result.skipped == ["rag"] and result.installed == []
-    # only the state ConfigMap is re-applied; no workload churn
+    # only the state ConfigMap and the CR status are re-written;
+    # no workload churn
     new = kube.events[n_events:]
-    assert all("helmpipeline-pipe-state" in key for _, key in new)
+    assert all("helmpipeline-pipe-state" in key or verb.startswith("status")
+               for verb, key in new)
 
 
 def test_reconcile_upgrade_applies_diff_and_prunes():
@@ -213,6 +235,120 @@ def test_delete_drains_workloads_first():
     svc_idx = [i for i, k in enumerate(deletes) if "/Service/" in k]
     assert dep_idx and svc_idx and max(dep_idx) < min(svc_idx)
     assert kube.objects == {}   # nothing left, state CM included
+
+
+def _cr_status(kube, pipe):
+    obj = kube.get((API_VERSION, KIND, pipe.namespace, pipe.name))
+    return (obj or {}).get("status")
+
+
+def test_reconcile_writes_cr_status():
+    """The pass outcome lands on the CR's status subresource — phase per
+    release, observedGeneration, Ready condition (the reference
+    controller's status reporting, helmpipeline_controller.go:62-116)."""
+    kube = InMemoryKube()
+    op = PipelineOperator(kube)
+    pipe = _pipeline()
+    kube.apply(pipe.to_manifest())
+    op.reconcile(pipe)
+    st = _cr_status(kube, pipe)
+    assert st["observedGeneration"] == pipe.generation
+    assert st["releases"]["rag"]["phase"] == "installed"
+    assert st["releases"]["rag"]["objects"] >= 12
+    assert st["conditions"][0] == {
+        "type": "Ready", "status": "True", "reason": "Reconciled",
+        "message": "1 installed, 0 unchanged"}
+    op.reconcile(pipe)
+    st = _cr_status(kube, pipe)
+    assert st["releases"]["rag"]["phase"] == "unchanged"
+    assert st["conditions"][0]["status"] == "True"
+
+
+def test_reconcile_status_reports_error_and_pending():
+    kube = InMemoryKube()
+    op = PipelineOperator(kube)
+    pipe = _pipeline(releases=("ok",))
+    pipe.packages.append(HelmPackage(
+        repo_name="local", repo_url="file:///nowhere",
+        chart_name="missing-chart", namespace="ns", release_name="broken"))
+    pipe.packages.append(HelmPackage(
+        repo_name="local", repo_url=f"file://{CHARTS}",
+        chart_name="rag-llm-pipeline", namespace="ns",
+        release_name="after"))
+    kube.apply(pipe.to_manifest())
+    op.reconcile(pipe)
+    st = _cr_status(kube, pipe)
+    assert st["releases"]["ok"]["phase"] == "installed"
+    assert st["releases"]["broken"]["phase"] == "error"
+    assert st["releases"]["after"]["phase"] == "pending"
+    cond = st["conditions"][0]
+    assert cond["status"] == "False" and cond["reason"] == "ReconcileError"
+    assert "broken" in cond["message"]
+
+
+def test_status_write_survives_missing_cr():
+    """Reconcile must not crash when the CR vanished (deletion race) —
+    the status write is best-effort."""
+    kube = InMemoryKube()
+    op = PipelineOperator(kube)
+    result = op.reconcile(_pipeline())  # CR never applied to the fake
+    assert result.error is None
+    assert ("status-miss",
+            f"{API_VERSION}/{KIND}/ns/pipe") in kube.events
+
+
+def test_fake_enforces_resource_version_conflict():
+    """The fake carries apiserver optimistic-concurrency semantics so a
+    controller bug that replays stale objects fails in tests, not prod."""
+    kube = InMemoryKube()
+    kube.apply({"apiVersion": "v1", "kind": "ConfigMap",
+                "metadata": {"name": "cm", "namespace": "ns"}})
+    stored = kube.get(("v1", "ConfigMap", "ns", "cm"))
+    rv = stored["metadata"]["resourceVersion"]
+    kube.apply(json.loads(json.dumps(stored)))  # fresh rv: fine
+    stale = json.loads(json.dumps(stored))
+    stale["metadata"]["resourceVersion"] = rv  # now one behind
+    with pytest.raises(ConflictError):
+        kube.apply(stale)
+    # rv-less apply is an SSA-style upsert (what the reconciler sends)
+    kube.apply({"apiVersion": "v1", "kind": "ConfigMap",
+                "metadata": {"name": "cm", "namespace": "ns"}})
+
+
+def test_apply_rejection_requeues_then_recovers():
+    """An admission rejection mid-walk aborts with requeue and a False
+    Ready condition; once the webhook clears, the next pass completes —
+    no state corruption in between."""
+    kube = InMemoryKube()
+    op = PipelineOperator(kube)
+    pipe = _pipeline()
+    kube.apply(pipe.to_manifest())
+    kube.reject = (lambda obj: "denied by policy"
+                   if obj.get("kind") == "Deployment" else None)
+    result = op.reconcile(pipe)
+    assert result.requeue and "denied by policy" in result.error
+    st = _cr_status(kube, pipe)
+    assert st["conditions"][0]["status"] == "False"
+    kube.reject = None
+    result = op.reconcile(pipe)
+    assert not result.requeue and result.installed == ["rag"]
+    assert _cr_status(kube, pipe)["conditions"][0]["status"] == "True"
+
+
+def test_iter_json_stream_reassembles_watch_events():
+    """kubectl --watch emits unframed concatenated JSON documents; the
+    parser must reassemble them across arbitrary chunk boundaries."""
+    events = [{"type": "ADDED", "object": {"metadata": {"name": "a"}}},
+              {"type": "MODIFIED",
+               "object": {"metadata": {"name": "b"},
+                          "spec": {"pipeline": []}}},
+              {"type": "DELETED", "object": {"metadata": {"name": "c"}}}]
+    text = "".join(json.dumps(e, indent=2) + "\n" for e in events)
+    # 7-byte chunks: every document spans many chunks
+    chunks = [text[i:i + 7] for i in range(0, len(text), 7)]
+    assert list(iter_json_stream(chunks)) == events
+    # and one giant chunk
+    assert list(iter_json_stream([text])) == events
 
 
 def test_drain_order_ranks():

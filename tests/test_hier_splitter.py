@@ -1,0 +1,93 @@
+"""Hierarchical chunking + auto-merging retrieval tests
+(the first-party analogue of the reference's
+notebooks/04_llamaindex_hier_node_parser.ipynb pipeline)."""
+
+import pytest
+
+from generativeaiexamples_tpu.chains.hier_splitter import (
+    AutoMergingIndex, HierarchicalSplitter)
+from generativeaiexamples_tpu.embed.encoder import HashEmbedder
+from generativeaiexamples_tpu.retrieval.docstore import DocumentIndex
+
+
+def _text(n_sentences=120):
+    return " ".join(
+        f"Sentence {i} about topic {'alpha' if i < 60 else 'beta'}."
+        for i in range(n_sentences))
+
+
+def test_split_builds_strict_tree():
+    sp = HierarchicalSplitter(chunk_sizes=(256, 64, 16))
+    nodes = sp.split(_text())
+    by_id = {n.id: n for n in nodes}
+    roots = [n for n in nodes if n.parent is None]
+    leaves = sp.leaves(nodes)
+    assert roots and leaves
+    assert all(n.level == 0 for n in roots)
+    for n in nodes:
+        for c in n.children:
+            assert by_id[c].parent == n.id
+            assert by_id[c].level == n.level + 1
+            # child text is contained in the parent window
+            assert by_id[c].text in n.text or by_id[c].text.strip() in n.text
+    # leaves are exactly the deepest level
+    assert {n.level for n in leaves} == {2}
+
+
+def test_chunk_sizes_must_decrease():
+    with pytest.raises(ValueError, match="strictly decrease"):
+        HierarchicalSplitter(chunk_sizes=(128, 128))
+    with pytest.raises(ValueError, match="strictly decrease"):
+        HierarchicalSplitter(chunk_sizes=(64, 256))
+
+
+def test_automerge_replaces_children_with_parent():
+    emb = HashEmbedder(dim=64)
+    ami = AutoMergingIndex(DocumentIndex(emb),
+                           HierarchicalSplitter(chunk_sizes=(256, 64, 16)),
+                           merge_ratio=0.5)
+    n_leaves = ami.add_document(_text(), source="doc")
+    assert n_leaves >= 8
+    # retrieve with k large enough that many sibling leaves hit: they
+    # must merge upward into larger windows
+    docs = ami.retrieve("topic alpha", k=min(n_leaves, 12))
+    assert docs
+    assert any(d.metadata.get("merged_depth", 0) >= 1 for d in docs), \
+        [d.metadata for d in docs]
+    merged = next(d for d in docs
+                  if d.metadata.get("merged_depth", 0) >= 1)
+    assert merged.metadata["level"] < 2          # coarser than a leaf
+    assert merged.metadata["merged_children"] > 1
+    # no duplicate nodes, scores ordered
+    keys = [(d.metadata["tree"], d.metadata["node_id"]) for d in docs]
+    assert len(keys) == len(set(keys))
+    scores = [d.score for d in docs]
+    assert scores == sorted(scores, reverse=True)
+
+
+def test_two_documents_with_same_source_keep_separate_trees():
+    """Node ids restart per document; two docs sharing a source string
+    must not cross-merge (regression: source-keyed tree map)."""
+    emb = HashEmbedder(dim=64)
+    ami = AutoMergingIndex(DocumentIndex(emb),
+                           HierarchicalSplitter(chunk_sizes=(256, 64, 16)))
+    ami.add_document(_text(), source="same.txt")
+    ami.add_document("Entirely different subject: cooking pasta. " * 30,
+                     source="same.txt")
+    docs = ami.retrieve("topic alpha", k=12)
+    assert docs
+    # every returned window's text must come from the tree it claims
+    for d in docs:
+        node = ami._trees[d.metadata["tree"]][d.metadata["node_id"]]
+        assert d.text == node.text
+
+
+def test_single_hit_is_not_merged():
+    emb = HashEmbedder(dim=64)
+    ami = AutoMergingIndex(DocumentIndex(emb),
+                           HierarchicalSplitter(chunk_sizes=(256, 64, 16)))
+    ami.add_document(_text(), source="doc")
+    docs = ami.retrieve("topic alpha", k=1)
+    assert len(docs) == 1
+    assert docs[0].metadata.get("merged_depth", 0) == 0
+    assert docs[0].metadata["level"] == 2

@@ -397,5 +397,22 @@ def test_jobs_api_202_poll_contract(tmp_path):
     assert _requests.post(f"{base}/v1/jobs", json={}, timeout=10
                           ).status_code == 422
 
+    # model registry: exact + substring resolution (the reference
+    # connector's get_available_models/_get_invoke_url, nv_aiplay.py:287)
+    assert "tiny" in client.available_models()
+    assert client.resolve_model("tiny") == "tiny"
+    assert client.resolve_model("tin") == "tiny"
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="unknown model"):
+        client.resolve_model("gpt-17")
+
+    # the LangChain wrapper rides the same poll loop
+    from generativeaiexamples_tpu.integrations.langchain_tpu import (
+        TpuJobsLLM)
+    llm = TpuJobsLLM(server_url=base, model_name="tiny", tokens=8,
+                     timeout=240)
+    out = llm.invoke("langchain job prompt")
+    assert isinstance(out, str) and out
+
     loop.call_soon_threadsafe(loop.stop)
     engine.stop()
