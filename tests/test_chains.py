@@ -255,3 +255,59 @@ def test_server_error_degrades_to_stream_message():
         finally:
             await client.close()
     _run(fn())
+
+
+# ------------------------------------------------------- fused RAG chatbot
+
+def test_developer_rag_fused_path_end_to_end(tmp_path):
+    """The chatbot auto-enables fused on-device RAG admission with an
+    in-process engine + on-device embedder: fused answers carry source
+    attribution, re-ingest does not recompile (stable spec), over-long
+    questions fall back to the host path and CLEAR the attribution."""
+    import jax
+    import jax.numpy as jnp
+
+    from generativeaiexamples_tpu.chains.llm import EngineLLM
+    from generativeaiexamples_tpu.embed.encoder import EmbeddingService
+    from generativeaiexamples_tpu.engine import Engine, EngineConfig
+    from generativeaiexamples_tpu.models import encoder, llama
+    from generativeaiexamples_tpu.models.configs import (ENCODER_TINY,
+                                                         LLAMA_TINY)
+    from generativeaiexamples_tpu.models.tokenizer import ByteTokenizer
+
+    emb = EmbeddingService(
+        encoder.init_params(ENCODER_TINY, jax.random.key(1), jnp.float32),
+        ENCODER_TINY, ByteTokenizer())
+    eng = Engine(
+        llama.init_params(LLAMA_TINY, jax.random.key(0), jnp.float32),
+        LLAMA_TINY, ByteTokenizer(),
+        EngineConfig(max_slots=2, max_input_length=1024,
+                     max_output_length=32, prefill_buckets=(128, 512),
+                     dtype="float32", page_size=64, kv_pool_tokens=None))
+    cfg = from_dict(AppConfig, {
+        "text_splitter": {"chunk_size": 100, "chunk_overlap": 20}})
+    ex = QAChatbot(llm=EngineLLM(eng), embedder=emb, config=cfg)
+    try:
+        for i, text in enumerate(["The MXU is a systolic array.",
+                                  "ICI links connect TPU chips."]):
+            p = tmp_path / f"d{i}.txt"
+            p.write_text(text)
+            ex.ingest_docs(str(p), f"d{i}.txt")
+        assert ex._fused_ready
+        spec = ex._fused_spec
+
+        out = "".join(ex.rag_chain("What is the MXU?", 8))
+        assert isinstance(out, str)
+        assert ex.last_sources, "fused answer lost attribution"
+
+        # another ingest with identical config must keep the spec
+        p = tmp_path / "extra.txt"
+        p.write_text("Paged KV caching pools pages.")
+        ex.ingest_docs(str(p), "extra.txt")
+        assert ex._fused_spec == spec
+
+        # over-long question -> host path; attribution cleared
+        "".join(ex.rag_chain("why " * 40, 8))
+        assert ex.last_sources == []
+    finally:
+        eng.stop()
