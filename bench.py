@@ -213,7 +213,8 @@ def run_engine_bench(engine, prompt_len: int, out_len: int, n_requests: int,
 
 
 def hbm_utilization(engine, model_cfg, tput: float, slots: int,
-                    prompt_len: int, out_len: int) -> tuple[float, float]:
+                    prompt_len: int, out_len: int
+                    ) -> tuple[float, float, bool]:
     """Achieved HBM bytes/s during steady decode vs the chip's peak.
 
     Per decode step the device must read every weight byte once plus the

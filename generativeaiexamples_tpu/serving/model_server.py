@@ -176,7 +176,8 @@ def build_services(model_type: str = "dev", model_name: str = "",
     world, tp, pp = resolve_topology(world_size, tp, pp)
     mesh = make_mesh(MeshPlan(tp=tp, pp=pp), jax.devices()[:world]) \
         if world > 1 else None
-    identity = f"{model_name}-{dtype}-{quantization or 'raw'}"
+    identity = base_identity = f"{model_name}-{dtype}-{quantization or 'raw'}"
+    hashed = False
     if model_path and not os.environ.get("GAIE_SKIP_HASH"):
         # Weight-content hash in the cache identity — the rebuild gate the
         # reference applies to its engine cache (model.py:230-241). XLA
@@ -186,6 +187,7 @@ def build_services(model_type: str = "dev", model_name: str = "",
         digest = fast_hash_dir(model_path)[:12]
         logger.info("checkpoint hash %s", digest)
         identity += f"-{digest}"
+        hashed = True
     setup_compile_cache(identity, world)
 
     if model_type == "dev":
@@ -199,12 +201,40 @@ def build_services(model_type: str = "dev", model_name: str = "",
     else:
         if not model_path:
             raise ConfigError(f"--model-path is required for {model_type}")
-        fmt = detect_checkpoint_format(model_path)
-        logger.info("model format: %s", fmt)
-        params = load_checkpoint(model_path, cfg, dtype=jnp.dtype(dtype))
         tokenizer = get_tokenizer(model_path)
 
-    if quantization:
+        def convert():
+            fmt = detect_checkpoint_format(model_path)
+            logger.info("model format: %s", fmt)
+            p = load_checkpoint(model_path, cfg, dtype=jnp.dtype(dtype))
+            if quantization:
+                from ..ops.quant import quantize_params
+                p = quantize_params(p, mode=quantization)
+            return p
+
+        # Converted-weight cache keyed by the same identity as the XLA
+        # compile cache (name+dtype+quant+content hash): restarts skip
+        # torch parsing + key mapping + quantization (SURVEY §5, the
+        # reference's engine-cache role, model.py:230-246). The cache is
+        # only trusted when the identity CARRIES the content hash —
+        # under GAIE_SKIP_HASH an updated checkpoint at the same path
+        # would silently serve stale weight bytes (for the compile cache
+        # that skip is safe: XLA programs embed no weights). Old-hash
+        # siblings are pruned on save (a converted 7B tree is multi-GB).
+        from ..models import weight_cache
+        if hashed:
+            params, from_cache = weight_cache.cached_or_convert(
+                identity, convert, prune_prefix=base_identity + "-")
+            if from_cache:
+                logger.info("converted weights served from cache "
+                            "(GAIE_WEIGHT_CACHE=0 disables)")
+        else:
+            if weight_cache.enabled():
+                logger.info("weight cache skipped: no content hash "
+                            "(GAIE_SKIP_HASH set or no model path)")
+            params = convert()
+
+    if quantization and model_type == "dev":
         from ..ops.quant import quantize_params
         params = quantize_params(params, mode=quantization)
 
