@@ -34,10 +34,11 @@ from __future__ import annotations
 
 from typing import Any, Optional
 
+import functools
+import os
+
 import jax
 import jax.numpy as jnp
-
-import os
 
 from ..ops.attention import gqa_attention
 from ..ops.quant import matmul as qmm
@@ -552,3 +553,105 @@ def apply_sp(params: Params, cfg: LlamaConfig, tokens: jax.Array,
                      in_specs=(seq_spec, seq_spec, P()),
                      out_specs=P(dp, "sp", None),
                      check_rep=False)(tokens, positions, params)
+
+
+@functools.lru_cache(maxsize=8)
+def _score_chunk_step(cfg: LlamaConfig):
+    """Jitted per-chunk forward, cached per config — a fresh jit wrapper
+    per score() call would re-trace the whole model every request."""
+    @jax.jit
+    def step(params, cache, tok_c, pos_c):
+        logits, cache = apply(params, cfg, tok_c, pos_c, cache,
+                              kv_valid_len=pos_c[:, -1] + 1)
+        return cache, logits
+    return step
+
+
+@functools.lru_cache(maxsize=8)
+def _score_full_fn(cfg: LlamaConfig):
+    @jax.jit
+    def full(params, tokens, positions):
+        logits, _ = apply(params, cfg, tokens, positions)
+        return logits
+    return full
+
+
+@functools.lru_cache(maxsize=8)
+def _score_sp_fn(cfg: LlamaConfig, mesh):
+    @jax.jit
+    def sp(params, tokens, positions):
+        return apply_sp(params, cfg, tokens, positions, mesh)
+    return sp
+
+
+def score(params: Params, cfg: LlamaConfig, tokens: jax.Array, *,
+          mesh=None, chunk: int = 2048) -> jax.Array:
+    """Per-token negative log-likelihood of a (long) sequence.
+
+    The served consumer of the long-context machinery: scoring/perplexity
+    of documents far beyond the engine's serving window. Two paths:
+
+    - **sp mesh** (``mesh`` with sp > 1): one ``apply_sp`` pass — ring
+      attention, activations sequence-sharded, so per-device memory is
+      ``1/sp`` of the unsharded forward. The path for sequences whose
+      activations cannot fit one chip.
+    - **single device**: chunked cached forward — chunks of ``chunk``
+      tokens stream through ``apply`` against a persistent KV cache, so
+      peak activation memory is one chunk's, with exact attention over
+      the full prefix. (KV for the whole sequence must still fit; that
+      is the boundary where the sp path takes over.)
+
+    tokens: (B, S) int32, S >= 2 (position 0 has no prediction).
+    Returns (B, S-1) float32 NLL of token t+1 given tokens <= t.
+    """
+    B, S = tokens.shape
+    if S < 2:
+        raise ValueError("score needs at least 2 tokens")
+    if chunk < 16:
+        raise ValueError(f"chunk must be >= 16, got {chunk}")
+
+    def nll_from(logits, targets):
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        return -jnp.take_along_axis(
+            logp, targets[..., None].astype(jnp.int32), axis=-1)[..., 0]
+
+    if mesh is not None and int(mesh.shape.get("sp", 1)) > 1:
+        # pad to an sp multiple; trailing pad positions are causally
+        # invisible to real tokens, and their NLL rows are dropped
+        n_sp = int(mesh.shape["sp"])
+        S_pad = -(-S // n_sp) * n_sp
+        padded = jnp.pad(tokens, ((0, 0), (0, S_pad - S)))
+        positions = jnp.broadcast_to(jnp.arange(S_pad, dtype=jnp.int32),
+                                     (B, S_pad))
+        logits = _score_sp_fn(cfg, mesh)(params, padded, positions)
+        return nll_from(logits[:, :S - 1], tokens[:, 1:])
+
+    if S <= chunk:
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+        logits = _score_full_fn(cfg)(params, tokens, positions)
+        return nll_from(logits[:, :-1], tokens[:, 1:])
+
+    # Chunked: pad S up to a chunk multiple so every call shares one
+    # compiled shape; the pad region is causally invisible to real tokens
+    # (absolute-position cache) and its NLL rows are dropped.
+    S_pad = -(-S // chunk) * chunk
+    padded = jnp.pad(tokens, ((0, 0), (0, S_pad - S)))
+    # final_norm is never quantized, so its dtype is the activation dtype
+    # (embed may be a QTensor dict on quantized trees)
+    cache = init_kv_cache(cfg, B, S_pad, params["final_norm"].dtype)
+    step = _score_chunk_step(cfg)
+
+    nll_parts = []
+    prev_last = None
+    for c0 in range(0, S_pad, chunk):
+        tok_c = jax.lax.dynamic_slice_in_dim(padded, c0, chunk, axis=1)
+        pos_c = jnp.broadcast_to(
+            jnp.arange(c0, c0 + chunk, dtype=jnp.int32), (B, chunk))
+        cache, logits = step(params, cache, tok_c, pos_c)
+        if prev_last is not None:
+            # the previous chunk's final position predicts this chunk's
+            # first token — stitch across the boundary
+            nll_parts.append(nll_from(prev_last, tok_c[:, :1]))
+        nll_parts.append(nll_from(logits[:, :-1], tok_c[:, 1:]))
+        prev_last = logits[:, -1:]
+    return jnp.concatenate(nll_parts, axis=1)[:, :S - 1]

@@ -19,6 +19,7 @@ from __future__ import annotations
 import argparse
 import hashlib
 import os
+import threading as _threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
@@ -310,8 +311,78 @@ def create_server_app(engine, embed_service=None,
         trace_dir, profiler_state["dir"] = profiler_state["dir"], None
         return web.json_response({"status": "written", "dir": trace_dir})
 
+    # One score at a time: each request materializes a dense full-length
+    # KV cache NEXT TO the engine's deliberately-HBM-filling pool, so
+    # unbounded concurrency would be a self-inflicted OOM.
+    score_gate = _threading.Semaphore(1)
+
+    async def score(request: web.Request) -> web.Response:
+        """Long-document scoring: per-token NLL / perplexity far beyond
+        the engine's serving window (models/llama.py score — chunked
+        cached forward on one chip, ring-attention apply_sp on an sp
+        mesh). The long-context surface the reference stack has no
+        equivalent of."""
+        import asyncio
+
+        import jax.numpy as jnp
+        import numpy as np
+
+        try:
+            body = await request.json()
+            if not isinstance(body, dict):
+                raise ValueError("body must be a JSON object")
+        except Exception as exc:  # noqa: BLE001 — malformed JSON -> 400
+            raise web.HTTPBadRequest(text=f"invalid JSON: {exc}") from exc
+        try:
+            chunk = int(body.get("chunk", 2048))
+            if chunk < 16:
+                raise ValueError(f"chunk must be >= 16, got {chunk}")
+            if "tokens" in body:
+                ids = [int(t) for t in body["tokens"]]
+            elif body.get("text"):
+                ids = engine.tokenizer.encode(str(body["text"]))
+            else:
+                raise ValueError("'text' or 'tokens' is required")
+            if len(ids) < 2:
+                raise ValueError("scoring needs at least 2 tokens")
+        except (ValueError, TypeError) as exc:
+            raise web.HTTPUnprocessableEntity(text=str(exc)) from exc
+        # Default sized for a 7B-class model sharing the chip with the
+        # serving pool (~2 GB of dense bf16 KV at 32k); raise it on
+        # chips with headroom or dedicated scoring servers.
+        max_score = int(os.environ.get("GAIE_MAX_SCORE_TOKENS", "32768"))
+        if len(ids) > max_score:
+            raise web.HTTPRequestEntityTooLarge(
+                max_size=max_score, actual_size=len(ids))
+        from ..models import llama as _llama
+
+        def run():
+            with score_gate:
+                tokens = jnp.asarray(np.asarray(ids, np.int32)[None, :])
+                nll = _llama.score(engine.params, engine.model_cfg, tokens,
+                                   mesh=engine.mesh, chunk=chunk)
+                return np.asarray(nll[0], np.float64)
+
+        try:
+            nll = await asyncio.get_running_loop().run_in_executor(None, run)
+        except Exception as exc:  # noqa: BLE001 — device OOM must not 500
+            if "RESOURCE_EXHAUSTED" in str(exc):
+                raise web.HTTPServiceUnavailable(
+                    text="scoring cache does not fit next to the serving "
+                         "pool; lower the document length or "
+                         "GAIE_MAX_SCORE_TOKENS") from exc
+            raise
+        mean = float(nll.mean())
+        out = {"model": model_name, "tokens": len(ids),
+               "mean_nll": round(mean, 6),
+               "perplexity": round(float(np.exp(mean)), 4)}
+        if body.get("per_token"):
+            out["nll"] = [round(float(x), 6) for x in nll]
+        return web.json_response(out)
+
     app.router.add_get("/health", health)
     app.router.add_get("/metrics", metrics_endpoint)
+    app.router.add_post("/v1/score", score)
     app.router.add_post("/profiler/start", profiler_start)
     app.router.add_post("/profiler/stop", profiler_stop)
     add_openai_routes(app, engine, model_name, embed_service=embed_service,
