@@ -19,7 +19,6 @@ from __future__ import annotations
 import argparse
 import hashlib
 import os
-import threading as _threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
@@ -313,8 +312,16 @@ def create_server_app(engine, embed_service=None,
 
     # One score at a time: each request materializes a dense full-length
     # KV cache NEXT TO the engine's deliberately-HBM-filling pool, so
-    # unbounded concurrency would be a self-inflicted OOM.
-    score_gate = _threading.Semaphore(1)
+    # unbounded concurrency would be a self-inflicted OOM. An asyncio
+    # semaphore (not a threading one inside the executor): waiters queue
+    # on the event loop instead of each pinning a shared-executor thread
+    # that the generation endpoints also need.
+    import asyncio as _asyncio
+    score_gate = _asyncio.Semaphore(1)
+    # Client-controlled chunk sizes each compile a fresh per-chunk
+    # program; an allowlist bounds the trace/compile surface (and caps
+    # the single-pass path's activation memory).
+    SCORE_CHUNKS = (64, 128, 256, 512, 1024, 2048, 4096, 8192)
 
     async def score(request: web.Request) -> web.Response:
         """Long-document scoring: per-token NLL / perplexity far beyond
@@ -333,38 +340,50 @@ def create_server_app(engine, embed_service=None,
                 raise ValueError("body must be a JSON object")
         except Exception as exc:  # noqa: BLE001 — malformed JSON -> 400
             raise web.HTTPBadRequest(text=f"invalid JSON: {exc}") from exc
+        # Default sized for a 7B-class model sharing the chip with the
+        # serving pool (~2 GB of dense bf16 KV at 32k); raise it on
+        # chips with headroom or dedicated scoring servers.
+        max_score = int(os.environ.get("GAIE_MAX_SCORE_TOKENS", "32768"))
+        loop = asyncio.get_running_loop()
         try:
             chunk = int(body.get("chunk", 2048))
-            if chunk < 16:
-                raise ValueError(f"chunk must be >= 16, got {chunk}")
+            if chunk not in SCORE_CHUNKS:
+                raise ValueError(f"chunk must be one of {SCORE_CHUNKS}")
             if "tokens" in body:
                 ids = [int(t) for t in body["tokens"]]
             elif body.get("text"):
-                ids = engine.tokenizer.encode(str(body["text"]))
+                text = str(body["text"])
+                # a sentencepiece token covers >= 1 byte, so a byte bound
+                # rejects hopeless documents before paying tokenization
+                if len(text.encode("utf-8", "ignore")) > max_score * 16:
+                    raise web.HTTPRequestEntityTooLarge(
+                        max_size=max_score * 16,
+                        actual_size=len(text))
+                # tokenize OFF the event loop: pure-Python BPE over a
+                # large document takes seconds and would freeze every
+                # in-flight SSE stream
+                ids = await loop.run_in_executor(
+                    None, engine.tokenizer.encode, text)
             else:
                 raise ValueError("'text' or 'tokens' is required")
             if len(ids) < 2:
                 raise ValueError("scoring needs at least 2 tokens")
         except (ValueError, TypeError) as exc:
             raise web.HTTPUnprocessableEntity(text=str(exc)) from exc
-        # Default sized for a 7B-class model sharing the chip with the
-        # serving pool (~2 GB of dense bf16 KV at 32k); raise it on
-        # chips with headroom or dedicated scoring servers.
-        max_score = int(os.environ.get("GAIE_MAX_SCORE_TOKENS", "32768"))
         if len(ids) > max_score:
             raise web.HTTPRequestEntityTooLarge(
                 max_size=max_score, actual_size=len(ids))
         from ..models import llama as _llama
 
         def run():
-            with score_gate:
-                tokens = jnp.asarray(np.asarray(ids, np.int32)[None, :])
-                nll = _llama.score(engine.params, engine.model_cfg, tokens,
-                                   mesh=engine.mesh, chunk=chunk)
-                return np.asarray(nll[0], np.float64)
+            tokens = jnp.asarray(np.asarray(ids, np.int32)[None, :])
+            nll = _llama.score(engine.params, engine.model_cfg, tokens,
+                               mesh=engine.mesh, chunk=chunk)
+            return np.asarray(nll[0], np.float64)
 
         try:
-            nll = await asyncio.get_running_loop().run_in_executor(None, run)
+            async with score_gate:
+                nll = await loop.run_in_executor(None, run)
         except Exception as exc:  # noqa: BLE001 — device OOM must not 500
             if "RESOURCE_EXHAUSTED" in str(exc):
                 raise web.HTTPServiceUnavailable(
