@@ -405,6 +405,34 @@ def main() -> None:
 
     t_start = time.monotonic()
     skip_e2e = bool(os.environ.get("BENCH_SKIP_E2E"))
+
+    # Device-attachment round-trip floor: a bare jit(x+1) dispatch +
+    # scalar readback. On this testbed's TUNNELED chip it measures
+    # ~110 ms p50 — the TTFT fixed cost is the attachment, not the
+    # serving stack (the fused admission already spends exactly ONE such
+    # round trip; 512-token 7B int8 prefill compute is ~35 ms on top).
+    # On a PCIe-attached production host this floor is <1 ms and the same
+    # stack would report TTFT near the compute cost. Published so the
+    # headline number is interpretable against the baseline.
+    def measure_rtt() -> float:
+        import jax
+        import jax.numpy as jnp
+        import statistics
+
+        f = jax.jit(lambda x: x + 1)
+        x = jnp.ones((8,))
+        float(f(x)[0])  # compile + warm
+        samples = []
+        for _ in range(5):
+            t0 = time.monotonic()
+            float(f(x)[0])
+            samples.append((time.monotonic() - t0) * 1e3)
+        return statistics.median(samples)
+
+    try:
+        rtt_ms = round(measure_rtt(), 1)
+    except Exception:  # noqa: BLE001 — diagnostic only
+        rtt_ms = None
     # Embedder first (and only once): the engine's auto-sized KV pool must
     # account for its memory, and the OOM fallback must not double it. An
     # embedder failure degrades to engine-only metrics, never aborts.
@@ -497,6 +525,7 @@ def main() -> None:
         "steps_per_round": engine.cfg.steps_per_round,
         "kv_pool_pages": engine._n_pages - 1,
         "device": str(jax.local_devices()[0].device_kind),
+        "dispatch_rtt_ms": rtt_ms,
         "n_devices": jax.local_device_count(),
         "bench_seconds": round(time.monotonic() - t_start, 1),
     }
