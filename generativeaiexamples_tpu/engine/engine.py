@@ -98,6 +98,7 @@ class TokenStream:
     def __init__(self, request_id: int):
         self.request_id = request_id
         self._q: "queue.Queue[tuple[str, object]]" = queue.Queue()
+        self._error: Optional[BaseException] = None
         self.finish_reason: Optional[str] = None
         self.token_ids: list[int] = []
         self.submit_time = time.monotonic()
@@ -118,6 +119,7 @@ class TokenStream:
         self._q.put(("done", reason))
 
     def _fail(self, exc: BaseException) -> None:
+        self._error = exc   # sticky: re-iteration re-raises, never hangs
         self.finish_reason = "error"
         self._q.put(("error", exc))
 
@@ -127,8 +129,41 @@ class TokenStream:
         self.cancelled = True
 
     def __iter__(self) -> Iterator[str]:
+        """Yield chunks until the terminal event. The terminal state is
+        STICKY: iterating a stream whose sentinel was already consumed
+        (a second ``text()`` call, a retrying client) returns — or
+        re-raises — immediately instead of blocking forever on the
+        drained queue (found by the submit/cancel/reset stress test)."""
         while True:
-            kind, payload = self._q.get()
+            try:
+                if self.finish_reason is not None and self._q.empty():
+                    raise queue.Empty  # already finished: sticky path now
+                # The timeout only bounds the idle wait for the sticky
+                # re-check; a queued item is returned immediately, so the
+                # streaming hot path pays nothing.
+                kind, payload = self._q.get(timeout=0.25)
+            except queue.Empty:
+                if self.finish_reason is None:
+                    continue
+                # finish_reason is set BEFORE the terminal sentinel is
+                # queued, and the retire path flushes tail chunks just
+                # before that — drain them rather than truncating the
+                # response of a slow-token stream that raced the finish.
+                while True:
+                    try:
+                        kind, payload = self._q.get_nowait()
+                    except queue.Empty:
+                        break
+                    if kind == "chunk":
+                        yield payload  # type: ignore[misc]
+                    elif kind == "error":
+                        raise EngineError(
+                            "engine failure") from payload  # type: ignore[arg-type]
+                    else:
+                        return
+                if self._error is not None:
+                    raise EngineError("engine failure") from self._error
+                return
             if kind == "chunk":
                 yield payload  # type: ignore[misc]
             elif kind == "error":
