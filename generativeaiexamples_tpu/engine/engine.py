@@ -86,6 +86,12 @@ class EngineConfig:
     # dispatches ride the device queue before the host blocks on results.
     steps_per_round: int = 8
     dispatch_depth: int = 2
+    # Long-prompt serving: cap the largest compiled prefill bucket; prompts
+    # beyond it stream through the paged pool in bucket-size chunks
+    # (bounded prefill activations/cache for e.g. 32k-token prompts).
+    # None = one-shot prefill up to max_input_length (the default; the
+    # chunked path never runs).
+    max_prefill_bucket: Optional[int] = None
 
     @property
     def max_cache_len(self) -> int:
@@ -241,9 +247,14 @@ class Engine:
         # before pool sizing — the auto sizer reserves headroom for the
         # largest bucket's prefill cache.
         page_up = lambda n: _ceil_div(n, page) * page  # noqa: E731
+        # max_prefill_bucket caps the one-shot prefill size; prompts past
+        # the cap take the chunked paged-prefill admission instead of
+        # compiling (and allocating) an arbitrarily large bucket.
+        cap = min(cfg.max_prefill_bucket or cfg.max_input_length,
+                  cfg.max_input_length)
         self._buckets = tuple(sorted(
-            {page_up(min(b, cfg.max_input_length)) for b in cfg.prefill_buckets}
-            | {page_up(cfg.max_input_length)}))
+            {page_up(min(b, cap)) for b in cfg.prefill_buckets}
+            | {page_up(cap)}))
 
         # The Pallas decode kernel has no SPMD partitioning rule, so mesh
         # serving shard_maps it over tp when the head counts divide
@@ -767,6 +778,7 @@ class Engine:
         self._release = jax.jit(release, donate_argnums=(0,))
         self._make_round = make_round
         self._round_fns: dict[tuple[int, int, bool], object] = {}
+        self._chunk_fns: dict[tuple, object] = {}
 
     def _round_fn(self, window: int, steps: int, greedy: bool):
         key = (window, steps, greedy)
@@ -776,6 +788,157 @@ class Engine:
                          donate_argnums=(1,))
             self._round_fns[key] = fn
         return fn
+
+    # --------------------------------------------- long-prompt admission
+
+    def _chunk_seen(self, state, tokens, start, valid, slot, first: bool):
+        """Accumulate the slot's seen-token mask chunk by chunk (the
+        repetition-penalty state the one-shot prefill computes in one
+        go). ``first`` REPLACES the previous occupant's stale mask."""
+        C = tokens.shape[1]
+        in_chunk = jnp.clip(valid - start, 0, C)
+        chunk_seen = seen_mask(tokens, in_chunk[None],
+                               self.model_cfg.vocab_size)[0]
+        if not first:
+            chunk_seen = state["seen"][slot] | chunk_seen
+        return state["seen"].at[slot].set(chunk_seen)
+
+    def _chunk_extend_fn(self, window: int, first: bool):
+        """Jitted ONE-CHUNK paged prefill for prompts longer than every
+        bucket: the chunk's KV lands in the slot's pool pages and its
+        attention reads the whole prefix back from the pool
+        (models/llama.py apply_prefill_paged). Non-final chunks skip the
+        vocab projection entirely."""
+        key = ("extend", window, first)
+        fn = self._chunk_fns.get(key)
+        if fn is None:
+            mcfg = self.model_cfg
+
+            def extend(state, params, tokens, start, valid, slot, row_win):
+                C = tokens.shape[1]
+                positions = (start + jnp.arange(C, dtype=jnp.int32))[None, :]
+                _, cache = llama.apply_prefill_paged(
+                    params, mcfg, tokens, positions, state["cache"],
+                    row_win, valid[None], start // self.cfg.page_size,
+                    with_logits=False)
+                return dict(state,
+                            cache=self._pin_cache(cache),
+                            seen=self._chunk_seen(state, tokens, start,
+                                                  valid, slot, first))
+
+            fn = jax.jit(extend, donate_argnums=(0,))
+            self._chunk_fns[key] = fn
+        return fn
+
+    def _chunk_final_fn(self, window: int, first: bool, greedy: bool):
+        """The LAST chunk: paged prefill + first-token sample + slot
+        arming in one dispatch — insert()'s non-cache half (the chunk
+        loop already scattered all prompt KV). Only the sampling
+        position is unembedded, not the whole chunk."""
+        key = ("final", window, first, greedy)
+        fn = self._chunk_fns.get(key)
+        if fn is None:
+            mcfg = self.model_cfg
+            eos = int(self.tokenizer.eos_id)
+
+            def final(state, params, tokens, start, valid, slot, row,
+                      row_win, temp, top_k, top_p, rep_pen, banned,
+                      bad_seq, bad_len, key_, remaining, eos_ok):
+                C = tokens.shape[1]
+                positions = (start + jnp.arange(C, dtype=jnp.int32))[None, :]
+                h, cache = llama.apply_prefill_paged(
+                    params, mcfg, tokens, positions, state["cache"],
+                    row_win, valid[None], start // self.cfg.page_size,
+                    with_logits=False)
+                seen = self._chunk_seen(state, tokens, start, valid, slot,
+                                        first)
+                idx = jnp.clip(valid - start - 1, 0, C - 1)
+                h_last = jnp.take_along_axis(
+                    h, idx[None, None, None].astype(jnp.int32), axis=1)
+                last = llama.unembed(params, mcfg, h_last)[0, 0]  # (V,)
+                last = apply_repetition_penalty(
+                    last[None, :], seen[slot][None, :], rep_pen[None])
+                last = jnp.where(banned[None, :], -1e30, last)
+                if greedy:
+                    first_tok = jnp.argmax(
+                        last[0].astype(jnp.float32)).astype(jnp.int32)
+                else:
+                    first_tok = sample(last, key_, temp[None], top_k[None],
+                                       top_p[None])[0]
+                active = (remaining > 0) & ~((first_tok == eos) & eos_ok)
+                length = valid
+                return dict(
+                    state,
+                    cache=self._pin_cache(cache),
+                    table=state["table"].at[slot].set(row),
+                    pos=state["pos"].at[slot].set(length),
+                    last_token=state["last_token"].at[slot].set(first_tok),
+                    active=state["active"].at[slot].set(active),
+                    remaining=state["remaining"].at[slot].set(remaining),
+                    eos_ok=state["eos_ok"].at[slot].set(eos_ok),
+                    temp=state["temp"].at[slot].set(temp),
+                    top_k=state["top_k"].at[slot].set(top_k),
+                    top_p=state["top_p"].at[slot].set(top_p),
+                    rep_pen=state["rep_pen"].at[slot].set(rep_pen),
+                    seen=seen.at[jnp.asarray(slot), first_tok].set(True),
+                    banned=state["banned"].at[slot].set(banned),
+                    bad_seq=state["bad_seq"].at[slot].set(bad_seq),
+                    bad_len=state["bad_len"].at[slot].set(bad_len),
+                    recent=state["recent"].at[slot].set(
+                        jnp.full((self.MAX_BAD_LEN - 1,), -1, jnp.int32)
+                        .at[-1].set(first_tok))), first_tok
+
+            fn = jax.jit(final, donate_argnums=(0,))
+            self._chunk_fns[key] = fn
+        return fn
+
+    def _admit_chunked(self, req: _Request, sp: SamplingParams, slot: int,
+                       row: np.ndarray, banned, bad_seq, bad_len,
+                       key) -> jax.Array:
+        """Stream a longer-than-any-bucket prompt through the paged pool
+        in chunk-size pieces; returns the first sampled token (device).
+        Each chunk is its own dispatch — long-prompt TTFT pays
+        n_chunks round trips, which only long prompts ever see."""
+        C = self._buckets[-1]
+        n = len(req.prompt_ids)
+        n_chunks = _ceil_div(n, C)
+        page = self.cfg.page_size
+        # The gather window must cover the PADDED chunk span, not just the
+        # request extent: a final chunk whose padding runs past the window
+        # would make dynamic_update_slice/dynamic_slice CLAMP their starts
+        # and silently relocate its KV over the prompt's own pages
+        # (review catch). Pages past the extent map to the trash page 0.
+        span_pages = n_chunks * (C // page)
+        window = max(self._window_for(_ceil_div(req.extent, page)),
+                     span_pages)
+        row_ext = np.zeros((window,), np.int32)
+        row_ext[:min(len(row), window)] = row[:min(len(row), window)]
+        row_win = jnp.asarray(row_ext[None, :])
+        padded = req.prompt_ids + [0] * (n_chunks * C - n)
+        first_tok = None
+        for i in range(n_chunks):
+            toks = jnp.asarray(np.asarray(
+                padded[i * C:(i + 1) * C], np.int32)[None, :])
+            start = jnp.int32(i * C)
+            valid = jnp.int32(min(n, (i + 1) * C))
+            self._guard_live()
+            if i < n_chunks - 1:
+                new_state = self._chunk_extend_fn(window, i == 0)(
+                    self._state, self.params, toks, start, valid,
+                    jnp.int32(slot), row_win)
+            else:
+                new_state, first_tok = self._chunk_final_fn(
+                    window, i == 0, req.greedy)(
+                    self._state, self.params, toks, start, valid,
+                    jnp.int32(slot), jnp.asarray(row), row_win,
+                    jnp.float32(sp.temperature), jnp.int32(sp.top_k),
+                    jnp.float32(sp.top_p),
+                    jnp.float32(sp.repetition_penalty), banned, bad_seq,
+                    bad_len, key, jnp.int32(req.eff_max - 1),
+                    jnp.bool_(not sp.ignore_eos))
+            self._guard_live()
+            self._state = new_state
+        return first_tok
 
     # ------------------------------------------------------------- lifecycle
 
@@ -1274,6 +1437,15 @@ class Engine:
                     bad_len, key,
                     jnp.int32(req.eff_max - 1), jnp.bool_(not sp.ignore_eos),
                     req.greedy)
+            elif len(req.prompt_ids) > self._buckets[-1]:
+                # Long-prompt admission: the prompt streams through the
+                # paged pool in bucket-size chunks (each chunk attends
+                # the pooled prefix) — prompts are no longer capped by
+                # the largest compiled prefill bucket.
+                first_tok = self._admit_chunked(req, sp, slot, row,
+                                                banned, bad_seq, bad_len,
+                                                key)
+                new_state = self._state  # committed chunk-by-chunk
             else:
                 bucket = self._bucket_for(len(req.prompt_ids))
                 ids = req.prompt_ids + [0] * (bucket - len(req.prompt_ids))

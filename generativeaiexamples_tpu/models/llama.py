@@ -305,6 +305,89 @@ def apply_decode_paged(params: Params, cfg: LlamaConfig, tokens: jax.Array,
     return unembed(params, cfg, h), cache
 
 
+def apply_prefill_paged(params: Params, cfg: LlamaConfig, tokens: jax.Array,
+                        positions: jax.Array, kv_cache: KVCache,
+                        block_table: jax.Array, kv_valid_len: jax.Array,
+                        start_page_idx: jax.Array, *,
+                        with_logits: bool = True,
+                        ) -> tuple[jax.Array, KVCache]:
+    """One CHUNK of a long-prompt prefill over the paged KV pool (B=1).
+
+    The piece that lets the engine serve prompts longer than any single
+    prefill bucket: the prompt streams through in page-aligned chunks,
+    each chunk's KV lands in the slot's pool pages, and its attention
+    reads the whole prefix back from the pool — exact attention, bounded
+    activation memory (one chunk's worth).
+
+    tokens/positions: (1, C), C a page multiple, positions starting at a
+    page boundary. block_table: (1, P) logical→physical window covering
+    at least ``kv_valid_len`` tokens. kv_valid_len: (1,) = chunk start +
+    valid tokens in this chunk (padding rows beyond it are causally
+    masked AND their pool rows are later overwritten or never read).
+    start_page_idx: () int32 — logical page index of the chunk's first
+    row; destination pages are ``block_table[0, start_page_idx + i]``.
+    Returns (logits (1, C, V) float32, updated pool) — or the raw
+    hidden states (1, C, D) with ``with_logits=False`` (non-final
+    chunks skip the vocab projection; the caller unembeds just the
+    sampling position).
+
+    Same memory discipline as the decode path's jnp branch: the layer
+    scan only READS the pool; per-layer chunk KV is collected as stacked
+    scan outputs and scattered into the pages once, after the scan — the
+    chunk rides the gathered window in-register for its own attention.
+    """
+    B, C = tokens.shape
+    if B != 1:
+        raise ValueError("apply_prefill_paged is single-request (B=1)")
+    P = block_table.shape[1]
+    page = kv_cache["k"].shape[3]  # (L, N, KV, page, hd)
+    if C % page:
+        raise ValueError(f"chunk {C} not a page ({page}) multiple")
+    nb = C // page
+    inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta,
+                                cfg.rope_scaling_factor)
+    h = jnp.take(params["embed"], tokens, axis=0)
+    start = positions[0, 0]  # absolute position of the chunk's first row
+
+    def layer(h: jax.Array, xs):
+        lp, kc, vc = xs  # kc/vc: (N, KV, page, hd) — read-only here
+
+        def attend(q, k, v):
+            kg = kc[block_table].swapaxes(2, 3).reshape(
+                B, P * page, cfg.num_kv_heads, cfg.head_dim)
+            vg = vc[block_table].swapaxes(2, 3).reshape(
+                B, P * page, cfg.num_kv_heads, cfg.head_dim)
+            # this chunk joins the window in-register; its pool write
+            # happens in the one post-scan scatter
+            kg = jax.lax.dynamic_update_slice(
+                kg, k.astype(kg.dtype), (0, start, 0, 0))
+            vg = jax.lax.dynamic_update_slice(
+                vg, v.astype(vg.dtype), (0, start, 0, 0))
+            return gqa_attention(q, kg, vg, positions, kv_valid_len), \
+                (k[0], v[0])
+
+        return decoder_layer(h, lp, cfg, positions, inv_freq, kv_valid_len,
+                             attend=attend)
+
+    h, (new_k, new_v) = jax.lax.scan(
+        layer, h, (params["layers"], kv_cache["k"], kv_cache["v"]))
+    # new_k/new_v: (L, C, KV, hd) -> (L, nb, KV, page, hd) page blocks,
+    # scattered at the chunk's physical pages in one shot.
+    L_ = new_k.shape[0]
+    dest = jax.lax.dynamic_slice(block_table[0], (start_page_idx,), (nb,))
+
+    def write(pool, new):
+        blocks = new.reshape(L_, nb, page, cfg.num_kv_heads,
+                             cfg.head_dim).swapaxes(2, 3)
+        return pool.at[:, dest].set(blocks.astype(pool.dtype))
+
+    cache = {"k": write(kv_cache["k"], new_k),
+             "v": write(kv_cache["v"], new_v)}
+    if not with_logits:
+        return h, cache
+    return unembed(params, cfg, h), cache
+
+
 def _dense_mlp(x: jax.Array, lp: dict[str, jax.Array],
                cfg: LlamaConfig) -> jax.Array:
     if cfg.mlp == "squared_relu":

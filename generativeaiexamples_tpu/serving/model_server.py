@@ -153,7 +153,7 @@ def build_services(model_type: str = "dev", model_name: str = "",
                    max_input_length: int = 3000, max_output_length: int = 512,
                    max_slots: int = 8, dtype: str = "bfloat16",
                    quantization: str = "", with_embedder: bool = True,
-                   seed: int = 0):
+                   seed: int = 0, max_prefill_bucket: Optional[int] = None):
     """Create (engine, embed_service, model_name) per the CLI/config."""
     import jax
     import jax.numpy as jnp
@@ -240,7 +240,8 @@ def build_services(model_type: str = "dev", model_name: str = "",
 
     engine_cfg = EngineConfig(
         max_slots=max_slots, max_input_length=max_input_length,
-        max_output_length=max_output_length, dtype=dtype, seed=seed)
+        max_output_length=max_output_length, dtype=dtype, seed=seed,
+        max_prefill_bucket=max_prefill_bucket)
     engine = Engine(params, cfg, tokenizer, engine_cfg, mesh=mesh)
     # Allocate-and-verify before serving: worst-case prefill/insert/round
     # transients run once and the pool shrinks on OOM instead of dying
@@ -430,6 +431,10 @@ def main(argv: Optional[list[str]] = None) -> None:
     parser.add_argument("--quantization", default="",
                         choices=["", "int8", "int4", "int4_awq"])
     parser.add_argument("--max-input-length", type=int, default=3000)
+    parser.add_argument("--max-prefill-bucket", type=int, default=0,
+                        help="cap the one-shot prefill bucket; longer "
+                             "prompts stream through the paged pool in "
+                             "chunks (long-context serving). 0 = off")
     parser.add_argument("--max-output-length", type=int, default=512)
     parser.add_argument("--max-batch-size", type=int, default=8)
     parser.add_argument("--dtype", default="bfloat16")
@@ -461,7 +466,8 @@ def main(argv: Optional[list[str]] = None) -> None:
         max_input_length=args.max_input_length,
         max_output_length=args.max_output_length,
         max_slots=args.max_batch_size, dtype=args.dtype,
-        with_embedder=not args.no_embedder)
+        with_embedder=not args.no_embedder,
+        max_prefill_bucket=args.max_prefill_bucket or None)
     engine.start()
     grpc_server = None  # keep the reference: grpc.Server stops when GC'd
     if args.grpc_port:

@@ -442,3 +442,84 @@ def test_stream_text_is_reentrant(engine):
         with pytest.raises(EngineError):
             bad.text()
     bad.cancel()  # let the loop retire it in the background
+
+
+def test_long_prompt_chunked_admission_matches_one_shot():
+    """Prompts beyond the largest prefill bucket stream through the paged
+    pool chunk by chunk (max_prefill_bucket). The chunked admission must
+    produce EXACTLY the one-shot engine's output — same greedy tokens,
+    same repetition-penalty state accumulated across chunks."""
+    params = llama.init_params(CFG, jax.random.key(21), dtype=jnp.float32)
+    prompt = [(i * 7) % 250 + 3 for i in range(100)]  # 100 > bucket 32
+
+    def build(cap):
+        return Engine(params, CFG, ByteTokenizer(), EngineConfig(
+            max_slots=2, max_input_length=128, max_output_length=16,
+            prefill_buckets=(32,), page_size=16, dtype="float32",
+            kv_pool_tokens=None, steps_per_round=4,
+            max_prefill_bucket=cap))
+
+    chunked = build(32)       # buckets capped at 32 -> 4 chunks
+    oneshot = build(None)     # auto bucket 128 covers the prompt
+    assert chunked._buckets[-1] == 32 and oneshot._buckets[-1] == 128
+    for sp in (SamplingParams(max_tokens=10, top_k=1, ignore_eos=True),
+               SamplingParams(max_tokens=10, top_k=1, ignore_eos=True,
+                              repetition_penalty=1.3)):
+        with chunked, oneshot:
+            a = chunked.submit(prompt, sp)
+            b = oneshot.submit(prompt, sp)
+            a.text(), b.text()
+        assert a.token_ids == b.token_ids, (a.token_ids, b.token_ids)
+        assert a.finish_reason == b.finish_reason == "length"
+
+
+def test_long_prompt_page_unaligned_and_continuation():
+    """Ragged long prompts (not chunk/page multiples) admit correctly and
+    decode continues across the chunk boundary; several concurrent long
+    and short requests share the pool."""
+    params = llama.init_params(CFG, jax.random.key(22), dtype=jnp.float32)
+    eng = Engine(params, CFG, ByteTokenizer(), EngineConfig(
+        max_slots=3, max_input_length=200, max_output_length=16,
+        prefill_buckets=(32,), page_size=16, dtype="float32",
+        kv_pool_tokens=None, steps_per_round=4, max_prefill_bucket=32))
+    with eng:
+        long1 = eng.submit([5] * 77, SamplingParams(max_tokens=6, top_k=1,
+                                                    ignore_eos=True))
+        short = eng.submit([9] * 10, SamplingParams(max_tokens=6, top_k=1,
+                                                    ignore_eos=True))
+        long2 = eng.submit([7] * 130, SamplingParams(max_tokens=6, top_k=1,
+                                                     ignore_eos=True))
+        for s in (long1, short, long2):
+            s.text()
+            assert s.finish_reason == "length"
+            assert len(s.token_ids) == 6
+    # parity for one of them against the pure forward
+    expected = greedy_reference(params, [5] * 77, 6)
+    assert long1.token_ids == expected
+
+
+def test_long_prompt_padded_span_beyond_window():
+    """Regression (review catch): a final chunk whose PADDING runs past
+    the extent-derived window used to clamp its scatter start and
+    overwrite the prompt's own pages. Geometry chosen so the padded
+    chunk span (2 chunks x 64 = 128 tokens) exceeds the extent (77 + 16
+    = 93 tokens -> 6 pages + ladder) — output must still equal the
+    one-shot engine's."""
+    params = llama.init_params(CFG, jax.random.key(23), dtype=jnp.float32)
+    prompt = [(i * 11) % 250 + 3 for i in range(77)]   # 77 > C=64
+
+    def build(cap, max_in):
+        return Engine(params, CFG, ByteTokenizer(), EngineConfig(
+            max_slots=1, max_input_length=max_in, max_output_length=16,
+            prefill_buckets=(64,), page_size=16, dtype="float32",
+            kv_pool_tokens=None, steps_per_round=4,
+            max_prefill_bucket=cap))
+
+    chunked = build(64, 80)   # extent 93 tokens; padded span 128
+    oneshot = build(None, 80)
+    sp = SamplingParams(max_tokens=10, top_k=1, ignore_eos=True)
+    with chunked, oneshot:
+        a = chunked.submit(prompt, sp)
+        b = oneshot.submit(prompt, sp)
+        a.text(), b.text()
+    assert a.token_ids == b.token_ids, (a.token_ids, b.token_ids)
