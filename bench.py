@@ -389,7 +389,10 @@ def main() -> None:
     quant = os.environ.get("BENCH_QUANT", "int8")
     prompt_len = int(os.environ.get("BENCH_PROMPT_LEN", "512"))
     out_len = int(os.environ.get("BENCH_OUTPUT_LEN", "64"))
-    n_requests = int(os.environ.get("BENCH_REQUESTS", "8"))
+    # 24 samples: with ~15-30 ms of per-request tunnel jitter, a p50 over
+    # 8 requests wobbles by tens of ms between runs; 24 tightens the
+    # estimator without materially lengthening the bench (~20 s).
+    n_requests = int(os.environ.get("BENCH_REQUESTS", "24"))
     # Slot-count choice (v5e, r4 sweep after the dynamic-window kernel):
     # decode throughput is now MONOTONE in slots — 4: 281, 8: 494,
     # 16: ~1030 tok/s (the r3 16-slot regression is gone) — but the

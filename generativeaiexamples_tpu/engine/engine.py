@@ -208,6 +208,11 @@ class _Request:
     # sequence prefix and masking the completing token (the reference's
     # to_word_list_format sequences, preprocessing/1/model.py:211).
     bad_seqs: list[list[int]] = field(default_factory=list)
+    # Device-ready renderings of the above, built ONCE at submit() on the
+    # caller's thread so the serve loop's admission dispatch stays lean.
+    banned_np: Optional[np.ndarray] = None
+    bad_seq_np: Optional[np.ndarray] = None
+    bad_len_np: Optional[np.ndarray] = None
     # Fused-RAG payload (q_llm (Sq,) int32, q_llm_len, q_enc (2, Se)):
     # admission runs the on-device retrieve+assemble+prefill program.
     rag: Optional[tuple] = None
@@ -830,12 +835,14 @@ class Engine:
             self._chunk_fns[key] = fn
         return fn
 
-    def _chunk_final_fn(self, window: int, first: bool, greedy: bool):
+    def _chunk_final_fn(self, window: int, greedy: bool):
         """The LAST chunk: paged prefill + first-token sample + slot
         arming in one dispatch — insert()'s non-cache half (the chunk
         loop already scattered all prompt KV). Only the sampling
         position is unembedded, not the whole chunk."""
-        key = ("final", window, first, greedy)
+        # always a non-first chunk: the chunked path only runs for
+        # n_chunks >= 2, so the seen mask was already reset by chunk 0
+        key = ("final", window, greedy)
         fn = self._chunk_fns.get(key)
         if fn is None:
             mcfg = self.model_cfg
@@ -851,7 +858,7 @@ class Engine:
                     row_win, valid[None], start // self.cfg.page_size,
                     with_logits=False)
                 seen = self._chunk_seen(state, tokens, start, valid, slot,
-                                        first)
+                                        first=False)
                 idx = jnp.clip(valid - start - 1, 0, C - 1)
                 h_last = jnp.take_along_axis(
                     h, idx[None, None, None].astype(jnp.int32), axis=1)
@@ -928,7 +935,7 @@ class Engine:
                     jnp.int32(slot), row_win)
             else:
                 new_state, first_tok = self._chunk_final_fn(
-                    window, i == 0, req.greedy)(
+                    window, req.greedy)(
                     self._state, self.params, toks, start, valid,
                     jnp.int32(slot), jnp.asarray(row), row_win,
                     jnp.float32(sp.temperature), jnp.int32(sp.top_k),
@@ -1121,6 +1128,21 @@ class Engine:
                 f"device table holds {self.MAX_BAD_SEQS}")
         return banned_ids, bad_seqs
 
+    def _render_bad_words(self, banned_ids: list[int],
+                          bad_seqs: list[list[int]]):
+        """Device-ready numpy renderings, built on the SUBMITTING thread
+        so the serve loop's admission dispatch does no mask assembly."""
+        banned_row = np.zeros((self.model_cfg.vocab_size,), bool)
+        if banned_ids:
+            banned_row[banned_ids] = True
+        seq_tbl = np.full((self.MAX_BAD_SEQS, self.MAX_BAD_LEN), -1,
+                          np.int32)
+        seq_len = np.zeros((self.MAX_BAD_SEQS,), np.int32)
+        for i, seq in enumerate(bad_seqs):
+            seq_tbl[i, :len(seq)] = seq
+            seq_len[i] = len(seq)
+        return banned_row, seq_tbl, seq_len
+
     # -------------------------------------------------------- fused RAG
 
     def enable_fused_rag(self, enc_params, enc_cfg, spec) -> None:
@@ -1207,6 +1229,8 @@ class Engine:
                 f"fused-RAG request needs {need} KV pages but the pool "
                 f"only has {self._n_pages - 1} (kv_pool_tokens too small)")
         banned_ids, bad_seqs = self._compile_bad_words(params)
+        banned_np, bad_seq_np, bad_len_np = self._render_bad_words(
+            banned_ids, bad_seqs)
         stream = TokenStream(next(self._req_counter))
         req = _Request(stream=stream, prompt_ids=[], params=params,
                        eff_max=eff_max, extent=spec.bucket + eff_max,
@@ -1214,6 +1238,8 @@ class Engine:
                        stop=StopChecker(params.stop_words),
                        greedy=(params.top_k == 1 or params.temperature <= 0),
                        banned_ids=banned_ids, bad_seqs=bad_seqs,
+                       banned_np=banned_np, bad_seq_np=bad_seq_np,
+                       bad_len_np=bad_len_np,
                        rag=(q_llm, len(ids), q_enc))
         try:
             self._pending.put_nowait((req, params))
@@ -1246,6 +1272,8 @@ class Engine:
                 f"request needs {need} KV pages but the pool only has "
                 f"{self._n_pages - 1} (kv_pool_tokens too small)")
         banned_ids, bad_seqs = self._compile_bad_words(params)
+        banned_np, bad_seq_np, bad_len_np = self._render_bad_words(
+            banned_ids, bad_seqs)
         stream = TokenStream(next(self._req_counter))
         req = _Request(stream=stream, prompt_ids=list(prompt_ids),
                        params=params, eff_max=eff_max,
@@ -1253,7 +1281,9 @@ class Engine:
                        detok=IncrementalDetokenizer(self.tokenizer),
                        stop=StopChecker(params.stop_words),
                        greedy=(params.top_k == 1 or params.temperature <= 0),
-                       banned_ids=banned_ids, bad_seqs=bad_seqs)
+                       banned_ids=banned_ids, bad_seqs=bad_seqs,
+                       banned_np=banned_np, bad_seq_np=bad_seq_np,
+                       bad_len_np=bad_len_np)
         try:
             self._pending.put_nowait((req, params))
         except queue.Full:
@@ -1404,18 +1434,15 @@ class Engine:
             record_stage("engine_admit_pickup",
                          time.monotonic() - req.stream.submit_time)
             t_dispatch = time.monotonic()
-            banned_row = np.zeros((self.model_cfg.vocab_size,), bool)
-            if req.banned_ids:
-                banned_row[req.banned_ids] = True
-            banned = jnp.asarray(banned_row)
-            seq_tbl = np.full((self.MAX_BAD_SEQS, self.MAX_BAD_LEN), -1,
-                              np.int32)
-            seq_len = np.zeros((self.MAX_BAD_SEQS,), np.int32)
-            for i, seq in enumerate(req.bad_seqs):
-                seq_tbl[i, :len(seq)] = seq
-                seq_len[i] = len(seq)
-            bad_seq = jnp.asarray(seq_tbl)
-            bad_len = jnp.asarray(seq_len)
+            # Masks/tables were built at submit() on the caller's thread
+            # (overlapped with the queue wait) — the serve loop only
+            # uploads them, keeping admission dispatch lean.
+            banned = jnp.asarray(req.banned_np)
+            bad_seq = jnp.asarray(req.bad_seq_np)
+            bad_len = jnp.asarray(req.bad_len_np)
+            # uploaded; don't pin ~vocab-size bytes per request for the
+            # rest of its lifetime (queue depth x 128k-vocab rows adds up)
+            req.banned_np = req.bad_seq_np = req.bad_len_np = None
             key = jax.random.fold_in(self._base_key,
                                      next(self._step_counter) ^ sp.random_seed)
             # ONE dispatch for (retrieve+assemble+)prefill+sample+insert,

@@ -309,7 +309,7 @@ def apply_prefill_paged(params: Params, cfg: LlamaConfig, tokens: jax.Array,
                         positions: jax.Array, kv_cache: KVCache,
                         block_table: jax.Array, kv_valid_len: jax.Array,
                         start_page_idx: jax.Array, *,
-                        with_logits: bool = True,
+                        with_logits: bool = False,
                         ) -> tuple[jax.Array, KVCache]:
     """One CHUNK of a long-prompt prefill over the paged KV pool (B=1).
 
@@ -326,10 +326,10 @@ def apply_prefill_paged(params: Params, cfg: LlamaConfig, tokens: jax.Array,
     masked AND their pool rows are later overwritten or never read).
     start_page_idx: () int32 — logical page index of the chunk's first
     row; destination pages are ``block_table[0, start_page_idx + i]``.
-    Returns (logits (1, C, V) float32, updated pool) — or the raw
-    hidden states (1, C, D) with ``with_logits=False`` (non-final
-    chunks skip the vocab projection; the caller unembeds just the
-    sampling position).
+    Returns (hidden states (1, C, D), updated pool) by default — the
+    engine unembeds only the sampling position; ``with_logits=True``
+    returns full (1, C, V) logits instead (a large transient at big
+    vocab x chunk; only for callers that truly need every position).
 
     Same memory discipline as the decode path's jnp branch: the layer
     scan only READS the pool; per-layer chunk KV is collected as stacked
