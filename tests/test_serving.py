@@ -416,3 +416,23 @@ def test_jobs_api_202_poll_contract(tmp_path):
 
     loop.call_soon_threadsafe(loop.stop)
     engine.stop()
+
+
+def test_build_services_long_prompt_cap():
+    """--max-prefill-bucket plumbs through build_services to the engine:
+    a dev server with a 32-token cap serves a prompt far beyond it via
+    the chunked paged-prefill admission."""
+    from generativeaiexamples_tpu.engine import SamplingParams
+    from generativeaiexamples_tpu.serving.model_server import build_services
+
+    engine, _, _ = build_services(
+        model_type="dev", max_slots=2, max_input_length=128,
+        max_output_length=16, dtype="float32", with_embedder=False,
+        max_prefill_bucket=32)
+    assert engine._buckets[-1] == 32
+    with engine:
+        s = engine.submit(list(range(3, 103)),   # 100 tokens > bucket 32
+                          SamplingParams(max_tokens=6, top_k=1,
+                                         ignore_eos=True))
+        s.text()
+    assert s.finish_reason == "length" and len(s.token_ids) == 6
