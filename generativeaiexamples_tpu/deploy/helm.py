@@ -10,7 +10,7 @@ supported subset is valid Helm syntax — the charts also render with real
 - ``{{ .Values.a.b }}``, ``{{ .Release.Name }}``, ``{{ .Release.Namespace }}``,
   ``{{ .Chart.Name }}``, ``{{ .Chart.Version }}``
 - pipes: ``| default <literal>``, ``| quote``, ``| int``, ``| toYaml``,
-  ``| nindent N``
+  ``| nindent N``, ``| sha256sum`` (sprig parity, checksum annotations)
 - blocks: ``{{- if <ref> }} ... {{- else }} ... {{- end }}`` and
   ``{{- if not <ref> }}`` (nestable, truthiness like Helm:
   absent/None/False/0/""/empty map are false)
@@ -23,6 +23,7 @@ Charts live as plain directories: ``Chart.yaml``, ``values.yaml``,
 
 from __future__ import annotations
 
+import hashlib
 import os
 import re
 from dataclasses import dataclass, field
@@ -118,6 +119,9 @@ def _apply_pipe(value: Any, pipe: str) -> Any:
         return int(value) if value not in (_SENTINEL, None) else 0
     if pipe == "toYaml":
         return yaml.safe_dump(value, default_flow_style=False).rstrip()
+    if pipe == "sha256sum":  # sprig parity: checksum annotations
+        v = "" if value in (_SENTINEL, None) else str(value)
+        return hashlib.sha256(v.encode()).hexdigest()
     m = re.match(r"nindent (\d+)$", pipe)
     if m:
         pad = " " * int(m.group(1))

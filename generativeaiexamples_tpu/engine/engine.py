@@ -52,7 +52,7 @@ from ..models.tokenizer import Tokenizer
 from ..ops.sampling import apply_repetition_penalty, sample, seen_mask
 from ..parallel.sharding import (llama_param_specs, paged_kv_cache_spec,
                                  shard_params)
-from ..utils.errors import EngineError, SchedulerFullError
+from ..utils.errors import ConfigError, EngineError, SchedulerFullError
 from .detokenizer import IncrementalDetokenizer, StopChecker
 from .sampling_params import SamplingParams
 
@@ -92,6 +92,23 @@ class EngineConfig:
     # None = one-shot prefill up to max_input_length (the default; the
     # chunked path never runs).
     max_prefill_bucket: Optional[int] = None
+
+    def __post_init__(self) -> None:
+        # Geometry validation lives on the config, not the engine — a bad
+        # flag must fail in milliseconds at parse/build time, never after
+        # minutes of checkpoint conversion (the reference rejects
+        # impossible engine shapes up front, model_server/__init__.py:
+        # 103-110). Prefill buckets scatter KV into whole pages, so the
+        # cap must be a page multiple >= one page.
+        if self.page_size <= 0:
+            raise ConfigError(f"page_size={self.page_size} must be > 0")
+        if self.max_prefill_bucket is not None and (
+                self.max_prefill_bucket < self.page_size
+                or self.max_prefill_bucket % self.page_size):
+            raise ConfigError(
+                f"max_prefill_bucket={self.max_prefill_bucket} must be a "
+                f"multiple of page_size={self.page_size} (>= one page); "
+                f"pass a smaller page_size to serve finer prefill caps")
 
     @property
     def max_cache_len(self) -> int:
@@ -254,7 +271,9 @@ class Engine:
         page_up = lambda n: _ceil_div(n, page) * page  # noqa: E731
         # max_prefill_bucket caps the one-shot prefill size; prompts past
         # the cap take the chunked paged-prefill admission instead of
-        # compiling (and allocating) an arbitrarily large bucket.
+        # compiling (and allocating) an arbitrarily large bucket. Cap
+        # geometry (page multiple >= one page) is validated loudly in
+        # EngineConfig.__post_init__.
         cap = min(cfg.max_prefill_bucket or cfg.max_input_length,
                   cfg.max_input_length)
         self._buckets = tuple(sorted(
@@ -1118,7 +1137,11 @@ class Engine:
                         f"bad_words entry {word!r} tokenizes to "
                         f"{len(seq)} tokens; the device-side sequence "
                         f"ban supports up to {self.MAX_BAD_LEN}")
-                bad_seqs.append(seq)
+                # dedupe across ALL entries, not just this word's
+                # spellings — duplicate sequences would burn device table
+                # slots and spuriously trip the MAX_BAD_SEQS cap
+                if seq not in bad_seqs:
+                    bad_seqs.append(seq)
             if not variants and not seqs:
                 raise EngineError(
                     f"bad_words entry {word!r} produced no tokens")

@@ -17,6 +17,7 @@ llm-inference-server/model_server/):
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import hashlib
 import os
 from concurrent.futures import ThreadPoolExecutor
@@ -153,7 +154,8 @@ def build_services(model_type: str = "dev", model_name: str = "",
                    max_input_length: int = 3000, max_output_length: int = 512,
                    max_slots: int = 8, dtype: str = "bfloat16",
                    quantization: str = "", with_embedder: bool = True,
-                   seed: int = 0, max_prefill_bucket: Optional[int] = None):
+                   seed: int = 0, max_prefill_bucket: Optional[int] = None,
+                   page_size: int = 0):
     """Create (engine, embed_service, model_name) per the CLI/config."""
     import jax
     import jax.numpy as jnp
@@ -172,6 +174,15 @@ def build_services(model_type: str = "dev", model_name: str = "",
     model_name = model_name or _TYPE_DEFAULT_NAME[model_type]
     cfg = get_model_config(model_name)
     model_path = resolve_azureml_model_dir(model_path)
+
+    # Engine geometry validates in EngineConfig.__post_init__ — construct
+    # it BEFORE checkpoint hashing/conversion so a bad flag fails in
+    # milliseconds, not after minutes of weight import.
+    engine_cfg = EngineConfig(
+        max_slots=max_slots, max_input_length=max_input_length,
+        max_output_length=max_output_length, dtype=dtype, seed=seed,
+        max_prefill_bucket=max_prefill_bucket,
+        page_size=page_size or EngineConfig.page_size)
 
     world, tp, pp = resolve_topology(world_size, tp, pp)
     mesh = make_mesh(MeshPlan(tp=tp, pp=pp), jax.devices()[:world]) \
@@ -238,10 +249,9 @@ def build_services(model_type: str = "dev", model_name: str = "",
         from ..ops.quant import quantize_params
         params = quantize_params(params, mode=quantization)
 
-    engine_cfg = EngineConfig(
-        max_slots=max_slots, max_input_length=max_input_length,
-        max_output_length=max_output_length, dtype=dtype, seed=seed,
-        max_prefill_bucket=max_prefill_bucket)
+    # dtype may have been resolved above (dev mode downgrades bfloat16 to
+    # float32 so the tiny model runs anywhere, incl CPU)
+    engine_cfg = dataclasses.replace(engine_cfg, dtype=dtype)
     engine = Engine(params, cfg, tokenizer, engine_cfg, mesh=mesh)
     # Allocate-and-verify before serving: worst-case prefill/insert/round
     # transients run once and the pool shrinks on OOM instead of dying
@@ -434,7 +444,11 @@ def main(argv: Optional[list[str]] = None) -> None:
     parser.add_argument("--max-prefill-bucket", type=int, default=0,
                         help="cap the one-shot prefill bucket; longer "
                              "prompts stream through the paged pool in "
-                             "chunks (long-context serving). 0 = off")
+                             "chunks (long-context serving). Must be a "
+                             "multiple of --page-size. 0 = off")
+    parser.add_argument("--page-size", type=int, default=0,
+                        help="KV pool page size in tokens (0 = default "
+                             "128); prefill buckets are page multiples")
     parser.add_argument("--max-output-length", type=int, default=512)
     parser.add_argument("--max-batch-size", type=int, default=8)
     parser.add_argument("--dtype", default="bfloat16")
@@ -467,7 +481,8 @@ def main(argv: Optional[list[str]] = None) -> None:
         max_output_length=args.max_output_length,
         max_slots=args.max_batch_size, dtype=args.dtype,
         with_embedder=not args.no_embedder,
-        max_prefill_bucket=args.max_prefill_bucket or None)
+        max_prefill_bucket=args.max_prefill_bucket or None,
+        page_size=args.page_size)
     engine.start()
     grpc_server = None  # keep the reference: grpc.Server stops when GC'd
     if args.grpc_port:

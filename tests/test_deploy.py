@@ -126,9 +126,18 @@ def test_jupyter_requires_token():
     objs = render_chart(chart, "r", "ns", values={
         "jupyter": {"enabled": True, "token": "s3cret"}})
     jup = [o for o in objs if "jupyter" in o["metadata"]["name"]]
-    assert {o["kind"] for o in jup} == {"Deployment", "Service"}
-    args = jup[0]["spec"]["template"]["spec"]["containers"][0]["args"]
-    assert "--NotebookApp.token=s3cret" in args
+    assert {o["kind"] for o in jup} == {"Secret", "Deployment", "Service"}
+    # the token must ride the Secret + env var, never a literal arg
+    # (args are readable via the pod spec and node process list)
+    secret = next(o for o in jup if o["kind"] == "Secret")
+    assert secret["stringData"]["token"] == "s3cret"
+    container = next(o for o in jup if o["kind"] == "Deployment")[
+        "spec"]["template"]["spec"]["containers"][0]
+    assert "--NotebookApp.token=$(JUPYTER_TOKEN)" in container["args"]
+    assert not any("s3cret" in a for a in container["args"])
+    env = {e["name"]: e for e in container["env"]}
+    ref = env["JUPYTER_TOKEN"]["valueFrom"]["secretKeyRef"]
+    assert ref == {"name": "r-jupyter-token", "key": "token"}
     # disabled by default
     assert not any("jupyter" in o["metadata"]["name"]
                    for o in render_chart(chart, "r", "ns"))

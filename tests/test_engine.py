@@ -148,6 +148,19 @@ def test_bad_words_over_caps_rejected(engine):
             max_tokens=4, bad_words=many))
 
 
+def test_bad_words_duplicates_share_table_slots(engine):
+    """Duplicate bad_words entries dedupe GLOBALLY before the device
+    table cap — N copies of one word must never trip MAX_BAD_SEQS."""
+    dupes = ["zy"] * (Engine.MAX_BAD_SEQS + 3)
+    _, seqs = engine._compile_bad_words(
+        SamplingParams(max_tokens=2, bad_words=dupes))
+    assert len(seqs) == 2  # the word's 2 spellings, however many copies
+    s = engine.submit(engine.tokenizer.encode("p"), SamplingParams(
+        max_tokens=2, top_k=1, ignore_eos=True, bad_words=dupes))
+    s.text()
+    assert s.finish_reason == "length"
+
+
 def test_oversized_prompt_rejected(engine):
     with pytest.raises(EngineError):
         engine.submit([5] * 100, SamplingParams())

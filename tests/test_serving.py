@@ -419,16 +419,17 @@ def test_jobs_api_202_poll_contract(tmp_path):
 
 
 def test_build_services_long_prompt_cap():
-    """--max-prefill-bucket plumbs through build_services to the engine:
-    a dev server with a 32-token cap serves a prompt far beyond it via
-    the chunked paged-prefill admission."""
+    """--max-prefill-bucket + --page-size plumb through build_services to
+    the engine: a dev server with a 32-token cap (and matching 32-token
+    pages) serves a prompt far beyond it via the chunked paged-prefill
+    admission."""
     from generativeaiexamples_tpu.engine import SamplingParams
     from generativeaiexamples_tpu.serving.model_server import build_services
 
     engine, _, _ = build_services(
         model_type="dev", max_slots=2, max_input_length=128,
         max_output_length=16, dtype="float32", with_embedder=False,
-        max_prefill_bucket=32)
+        max_prefill_bucket=32, page_size=32)
     assert engine._buckets[-1] == 32
     with engine:
         s = engine.submit(list(range(3, 103)),   # 100 tokens > bucket 32
@@ -436,3 +437,32 @@ def test_build_services_long_prompt_cap():
                                          ignore_eos=True))
         s.text()
     assert s.finish_reason == "length" and len(s.token_ids) == 6
+
+
+def test_build_services_rejects_sub_page_prefill_cap():
+    """A max_prefill_bucket that is not a page multiple >= page_size is
+    invalid engine geometry (buckets scatter into whole pages) and must
+    fail loudly at build time, never silently round up (reference errors
+    on impossible engine shapes, model_server/__init__.py:103-110)."""
+    import pytest
+
+    from generativeaiexamples_tpu.serving.model_server import build_services
+    from generativeaiexamples_tpu.utils.errors import ConfigError
+
+    # below one (default 128-token) page
+    with pytest.raises(ConfigError, match="max_prefill_bucket"):
+        build_services(model_type="dev", max_slots=2, max_input_length=128,
+                       max_output_length=16, dtype="float32",
+                       with_embedder=False, max_prefill_bucket=32)
+    # not a multiple of the explicit page size
+    with pytest.raises(ConfigError, match="multiple of page_size"):
+        build_services(model_type="dev", max_slots=2, max_input_length=128,
+                       max_output_length=16, dtype="float32",
+                       with_embedder=False, max_prefill_bucket=48,
+                       page_size=32)
+    # nonsense page sizes fail at config construction, before any
+    # checkpoint work (validation lives in EngineConfig.__post_init__)
+    from generativeaiexamples_tpu.engine.engine import EngineConfig
+    for bad in (-16, 0):
+        with pytest.raises(ConfigError, match="page_size"):
+            EngineConfig(page_size=bad)
