@@ -48,12 +48,19 @@ class EvalReport:
 
 def chunks_from_example(example, max_chunks: int) -> list[tuple[str, dict]]:
     """Pull synthesis chunks straight from the example's document index —
-    each carries its store id, which becomes the retrieval gold label."""
+    each carries its store id, which becomes the retrieval gold label.
+    Stride-sampled across the whole corpus: taking the first N chunks
+    would draw every gold label from one or two (alphabetically first)
+    documents and leave the rest of the corpus unmeasured."""
     index = getattr(example, "index", None)
-    if index is None:
+    if index is None or max_chunks <= 0:
         return []
+    docs = sorted(index._docs.items())
+    if not docs:
+        return []
+    stride = max(1, len(docs) // max_chunks)
     chunks = []
-    for doc_id, doc in sorted(index._docs.items()):
+    for doc_id, doc in docs[::stride]:
         chunks.append((doc.text, {"doc_id": doc_id,
                                   "source": doc.metadata.get("source", "")}))
         if len(chunks) >= max_chunks:
@@ -94,14 +101,14 @@ def run_eval(example, judge_llm, cfg: EvalConfig = EvalConfig(),
 
     faith_scores: list[Optional[float]] = []
     precision_scores: list[Optional[float]] = []
-    retrieval_scores: list[dict] = []
+    retrieval_scores: list[tuple[str, dict]] = []
     ratings: list[Optional[int]] = []
 
     for qa in qa_pairs:
         fill_rag_outputs(example, qa, cfg)
         r = retrieval_metrics(qa.context_ids, qa.gt_doc_id, cfg.top_k)
         if r is not None:
-            retrieval_scores.append(r)
+            retrieval_scores.append((qa.synthetic_mode, r))
         if cfg.ragas:
             faith_scores.append(faithfulness(
                 judge_llm, qa.question, qa.answer, qa.contexts))
@@ -112,20 +119,27 @@ def run_eval(example, judge_llm, cfg: EvalConfig = EvalConfig(),
                                      qa.gt_answer, qa.answer)
             ratings.append(rating)
 
+    modes: dict[str, int] = {}
+    for q in qa_pairs:
+        modes[q.synthetic_mode] = modes.get(q.synthetic_mode, 0) + 1
     metrics: dict = {
         "num_questions": len(qa_pairs),
-        "synthetic_llm": sum(1 for q in qa_pairs
-                             if q.synthetic_mode == "llm"),
-        "synthetic_extractive": sum(1 for q in qa_pairs
-                                    if q.synthetic_mode == "extractive"),
+        "synthetic_modes": modes,
         "top_k": cfg.top_k,
     }
     if retrieval_scores:
-        metrics["retrieval"] = {
-            key: round(sum(s[key] for s in retrieval_scores)
-                       / len(retrieval_scores), 4)
-            for key in ("ndcg", "hit", "mrr")}
-        metrics["retrieval"]["scored"] = len(retrieval_scores)
+        def agg(scores: list[dict]) -> dict:
+            out = {key: round(sum(s[key] for s in scores) / len(scores), 4)
+                   for key in ("ndcg", "hit", "mrr")}
+            out["scored"] = len(scores)
+            return out
+
+        metrics["retrieval"] = agg([s for _, s in retrieval_scores])
+        # per-mode split: quote-back questions are near-trivial for a
+        # lexical retriever; the keyword/llm modes carry the signal
+        metrics["retrieval"]["by_mode"] = {
+            mode: agg([s for m, s in retrieval_scores if m == mode])
+            for mode in sorted({m for m, _ in retrieval_scores})}
     if cfg.ragas:
         metrics["faithfulness"] = _round(mean_of(faith_scores))
         metrics["faithfulness_scored"] = sum(

@@ -134,6 +134,42 @@ def extractive_pair(chunk: str) -> tuple[str, str]:
             lead)
 
 
+_STOPWORDS = frozenset(
+    "a an the and or but of to in on for with is are was were be been it "
+    "its this that these those as at by from so no not into over under "
+    "such can may will would should could does do did done their there "
+    "they them then than when where which while what who whose how all "
+    "any each more most some only also very just both about between "
+    "after before during against through".split())
+
+
+def keyword_pair(chunk: str) -> Optional[tuple[str, str]]:
+    """Harder deterministic fallback: ask about the chunk's distinctive
+    terms WITHOUT quoting any sentence. The quote-back question is
+    near-trivial for a lexical retriever (its text IS the chunk's first
+    sentence), so on its own it saturates hit/nDCG at 1.0; this variant
+    gives the ranker only a handful of content words to work from,
+    keeping the retrieval metrics informative."""
+    words = re.findall(r"[A-Za-z][A-Za-z0-9_\-]{3,}", chunk)
+    seen: list[str] = []
+    lower_seen: set[str] = set()
+    for w in words:
+        lw = w.lower()
+        if lw in _STOPWORDS or lw in lower_seen:
+            continue
+        lower_seen.add(lw)
+        seen.append(w)
+    # distinctive ~= longest; stable position tie-break keeps it
+    # deterministic, then restore document order for a natural question
+    ranked = sorted(range(len(seen)), key=lambda i: (-len(seen[i]), i))
+    picks = [seen[i] for i in sorted(ranked[:3])]
+    if len(picks) < 2:
+        return None
+    q = ("What does the documentation say about "
+         + ", ".join(picks[:-1]) + " and " + picks[-1] + "?")
+    return q, _first_sentence(chunk)
+
+
 def generate_qa_pairs(llm, chunks: Sequence[tuple[str, dict]],
                       pairs_per_chunk: int = 2, max_retries: int = 1,
                       max_tokens: int = 300,
@@ -153,11 +189,15 @@ def generate_qa_pairs(llm, chunks: Sequence[tuple[str, dict]],
             pairs = extract_qa_json(text)
             if pairs:
                 break
-        mode = "llm"
-        if not pairs and extractive_fallback:
-            pairs = [extractive_pair(chunk)]
-            mode = "extractive"
-        for q, a in pairs[:pairs_per_chunk]:
+        records = [(q, a, "llm") for q, a in pairs]
+        if not records and extractive_fallback:
+            # deterministic ladder: a keyword question first (retrieval
+            # actually has to rank), then the near-trivial quote-back
+            kw = keyword_pair(chunk)
+            if kw is not None:
+                records.append((*kw, "keyword"))
+            records.append((*extractive_pair(chunk), "extractive"))
+        for q, a, mode in records[:pairs_per_chunk]:
             out.append(QAPair(
                 question=q, gt_answer=a, gt_context=chunk,
                 gt_doc_id=meta.get("doc_id"), source=meta.get("source", ""),

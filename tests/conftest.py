@@ -39,3 +39,9 @@ def cpu_devices():
     devs = jax.devices()
     assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
     return devs
+
+
+@pytest.fixture(scope="session")
+def repo_root():
+    import pathlib
+    return pathlib.Path(__file__).resolve().parent.parent

@@ -75,10 +75,12 @@ def test_generate_qa_pairs_retry_then_fallback():
     pairs = generate_qa_pairs(llm, [("The MXU is a systolic array. More.",
                                      {"doc_id": 7, "source": "a.txt"})],
                               max_retries=1)
-    assert len(pairs) == 1
-    assert pairs[0].synthetic_mode == "extractive"
-    assert pairs[0].gt_doc_id == 7
-    assert "MXU" in pairs[0].question
+    # deterministic ladder: harder keyword question first, quote-back second
+    assert [p.synthetic_mode for p in pairs] == ["keyword", "extractive"]
+    assert all(p.gt_doc_id == 7 for p in pairs)
+    assert "MXU" in pairs[1].question
+    # the keyword question must not quote the chunk's sentence verbatim
+    assert "The MXU is a systolic array." not in pairs[0].question
     assert len(llm.prompts) == 2  # initial + one retry
 
 
@@ -202,7 +204,10 @@ def test_run_eval_dev_stack(tmp_path):
     assert m["retrieval"]["ndcg"] > 0.8
     assert m["retrieval"]["hit"] > 0.8
     assert m["num_questions"] >= 3
-    assert m["synthetic_extractive"] == m["num_questions"]
+    assert (sum(m["synthetic_modes"].values()) == m["num_questions"]
+            and set(m["synthetic_modes"]) <= {"keyword", "extractive"})
+    # per-mode breakdown accompanies the aggregate
+    assert set(m["retrieval"]["by_mode"]) == set(m["synthetic_modes"])
     # echo LLM parses no verdicts/ratings: reported as unscored, not fake
     assert m["faithfulness"] is None
     assert m["judge"]["unparsed"] == m["num_questions"]
@@ -231,7 +236,7 @@ def test_run_eval_scripted_full_scores():
     ])
     report = run_eval(example, judge, EvalConfig(max_questions=1))
     m = report.metrics
-    assert m["synthetic_llm"] == 1
+    assert m["synthetic_modes"] == {"llm": 1}
     assert m["faithfulness"] == 1.0
     assert m["context_precision"] == 1.0
     assert m["judge"]["mean_rating"] == 4.0
@@ -245,5 +250,40 @@ def test_eval_cli_runs_headless(tmp_path):
         capture_output=True, text=True, timeout=240)
     assert proc.returncode == 0, proc.stderr
     metrics = json.loads(proc.stdout)
-    assert metrics["retrieval"]["ndcg"] == 1.0
+    # the 4-doc builtin corpus: keyword + quote-back questions both land
+    assert metrics["retrieval"]["ndcg"] >= 0.8
     assert out.exists()
+
+
+def test_repo_root_eval_artifact(tmp_path, repo_root):
+    """The round artifact generator (eval.py) runs the LIVE-server eval
+    end-to-end on the dev stack and writes a structurally complete
+    EVAL_r{NN}.json."""
+    corpus = tmp_path / "docs"
+    corpus.mkdir()
+    (corpus / "a.md").write_text(
+        "The MXU is a 128x128 systolic array for matrix multiplies. "
+        "Feeding it large batched bfloat16 matmuls keeps utilization high.")
+    (corpus / "b.md").write_text(
+        "Paged KV caching shares a pool of fixed-size pages between "
+        "decode slots, sizing cache capacity to HBM instead of batch.")
+    (corpus / "c.md").write_text(
+        "Continuous batching admits new requests between decode steps "
+        "without recompiling the executable.")
+    out = tmp_path / "EVAL_r99.json"
+    proc = subprocess.run(
+        [sys.executable, str(repo_root / "eval.py"), "--round", "99",
+         "--output", str(out), "--corpus", str(corpus),
+         "--max-questions", "4", "--max-chunks", "4", "--num-tokens", "8",
+         "--world-size", "1"],
+        capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stderr
+    artifact = json.loads(out.read_text())
+    assert artifact["round"] == 99
+    assert artifact["stack"]["weights"] == "random-init"
+    assert "live chain-server" in artifact["stack"]["transport"]
+    m = artifact["metrics"]
+    assert 0.0 <= m["retrieval"]["ndcg"] <= 1.0 and m["retrieval"]["scored"]
+    # every question produced a non-error answer through the live server
+    assert artifact["generation"]["answers"] == m["num_questions"]
+    assert len(artifact["questions"]) == m["num_questions"]
