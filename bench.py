@@ -153,6 +153,7 @@ def build_engine(model_name: str, slots: int, prompt_len: int, out_len: int,
         max_slots=slots, max_input_length=max_in, max_output_length=max_out,
         prefill_buckets=(512, 1024, max_in), dtype="bfloat16",
         kv_pool_tokens="auto",
+        kv_quant=os.environ.get("BENCH_KV_QUANT", ""),
         steps_per_round=int(os.environ.get("BENCH_STEPS_PER_ROUND", "16")),
         dispatch_depth=int(os.environ.get("BENCH_DISPATCH_DEPTH", "2")))
     engine = Engine(params, cfg, tokenizer, ecfg)
@@ -520,6 +521,7 @@ def main() -> None:
         "e2e_chat_ttft_ms": round(e2e_p50, 2) if e2e_p50 else None,
         "e2e_breakdown_ms": e2e_breakdown,
         "quantization": quant,
+        "kv_quant": engine.cfg.kv_quant or None,
         "weights": "real" if os.environ.get("BENCH_MODEL_PATH")
         else "random-init",
         "prompt_len": prompt_len,

@@ -92,6 +92,12 @@ class EngineConfig:
     # None = one-shot prefill up to max_input_length (the default; the
     # chunked path never runs).
     max_prefill_bucket: Optional[int] = None
+    # KV-cache quantization: "" (pool in `dtype`) or "int8" (per-row
+    # symmetric int8 pools + bf16 scale pools, ops/kv_quant.py) — halves
+    # KV bytes per token, so the auto-sized pool holds ~2x the pages at
+    # fixed HBM (the reference's batch-128 capacity rides the same
+    # TRT-LLM lever; reference: config.pbtxt.j2:29).
+    kv_quant: str = ""
 
     def __post_init__(self) -> None:
         # Geometry validation lives on the config, not the engine — a bad
@@ -102,6 +108,10 @@ class EngineConfig:
         # cap must be a page multiple >= one page.
         if self.page_size <= 0:
             raise ConfigError(f"page_size={self.page_size} must be > 0")
+        if self.kv_quant not in ("", "int8"):
+            raise ConfigError(
+                f"kv_quant={self.kv_quant!r} not supported; use '' or "
+                f"'int8'")
         if self.max_prefill_bucket is not None and (
                 self.max_prefill_bucket < self.page_size
                 or self.max_prefill_bucket % self.page_size):
@@ -257,6 +267,7 @@ class Engine:
         self.tokenizer = tokenizer
         self.mesh = mesh
         self._dtype = jnp.dtype(cfg.dtype)
+        self._kv_quant = bool(cfg.kv_quant)
         B, page = cfg.max_slots, cfg.page_size
         self._pmax = _ceil_div(cfg.max_cache_len, page)
 
@@ -341,7 +352,8 @@ class Engine:
         B = self.cfg.max_slots
         mcfg, mesh = self.model_cfg, self.mesh
         cache = llama.init_paged_kv_cache(mcfg, self._n_pages,
-                                          self.cfg.page_size, self._dtype)
+                                          self.cfg.page_size, self._dtype,
+                                          quantized=self._kv_quant)
         # Distinct arrays per field: donated jit args must not alias.
         state = {
             "cache": cache,
@@ -368,33 +380,35 @@ class Engine:
             "recent": jnp.full((B, self.MAX_BAD_LEN - 1), -1, jnp.int32),
         }
         if mesh is not None:
-            cache_specs = paged_kv_cache_spec(mcfg, mesh)
+            cache_specs = paged_kv_cache_spec(
+                mcfg, mesh, quantized=self._kv_quant)
             state = {
                 k: (jax.tree.map(
                         lambda x, s: jax.device_put(
-                            x, self._cache_placement(NamedSharding(mesh, s))),
+                            x, self._cache_placement(
+                                NamedSharding(mesh, s), x.ndim)),
                         v, cache_specs) if k == "cache"
                     else jax.device_put(v, NamedSharding(mesh, P())))
                 for k, v in state.items()}
         elif self._pin_layouts:
             from jax.sharding import SingleDeviceSharding
-            place = self._cache_placement(
-                SingleDeviceSharding(jax.local_devices()[0]))
+            dev_sharding = SingleDeviceSharding(jax.local_devices()[0])
             state["cache"] = jax.tree.map(
-                lambda x: jax.device_put(x, place), state["cache"])
+                lambda x: jax.device_put(
+                    x, self._cache_placement(dev_sharding, x.ndim)),
+                state["cache"])
         return state
 
     # ------------------------------------------------------------- layouts
 
-    _ROW_MAJOR_5D = (0, 1, 2, 3, 4)
-
-    def _cache_placement(self, sharding):
+    def _cache_placement(self, sharding, ndim: int = 5):
         """device_put target for pool leaves: row-major-pinned when the
-        Pallas kernel is in play, plain sharding otherwise."""
+        Pallas kernel is in play, plain sharding otherwise. Scale pools
+        (int8-KV mode) are 4D; their layout pins row-major too."""
         if not self._pin_layouts:
             return sharding
         from jax.experimental.layout import Format, Layout
-        return Format(Layout(major_to_minor=self._ROW_MAJOR_5D), sharding)
+        return Format(Layout(major_to_minor=tuple(range(ndim))), sharding)
 
     def _pin_cache(self, cache):
         """Constrain pool leaves to row-major inside a jitted program so
@@ -403,8 +417,9 @@ class Engine:
         if not self._pin_layouts:
             return cache
         from jax.experimental.layout import Layout, with_layout_constraint
-        lay = Layout(major_to_minor=self._ROW_MAJOR_5D)
-        return {k: with_layout_constraint(v, lay) for k, v in cache.items()}
+        return {k: with_layout_constraint(
+                    v, Layout(major_to_minor=tuple(range(v.ndim))))
+                for k, v in cache.items()}
 
     # -------------------------------------------------------------- sizing
 
@@ -420,6 +435,10 @@ class Engine:
 
     def _kv_bytes_per_token(self) -> int:
         mcfg = self.model_cfg
+        if self._kv_quant:
+            # int8 K+V rows + one bf16 scale each (ops/kv_quant.py)
+            return (mcfg.num_layers * mcfg.num_kv_heads
+                    * 2 * (mcfg.head_dim + 2))
         return (mcfg.num_layers * mcfg.num_kv_heads * mcfg.head_dim
                 * 2 * self._dtype.itemsize)
 
@@ -664,10 +683,25 @@ class Engine:
                                mcfg.head_dim).swapaxes(2, 3)
             vp = v_new.reshape(L, nb, page, mcfg.num_kv_heads,
                                mcfg.head_dim).swapaxes(2, 3)
-            cache = {
-                "k": cache["k"].at[:, dest].set(kp.astype(cache["k"].dtype)),
-                "v": cache["v"].at[:, dest].set(vp.astype(cache["v"].dtype)),
-            }
+            if self._kv_quant:
+                from ..ops.kv_quant import quantize_rows
+                kq, ks = quantize_rows(kp)   # scales: (L, nb, KV, page)
+                vq, vs = quantize_rows(vp)
+                cache = {
+                    "k": cache["k"].at[:, dest].set(kq),
+                    "v": cache["v"].at[:, dest].set(vq),
+                    "ks": cache["ks"].at[:, dest].set(
+                        ks.astype(cache["ks"].dtype)),
+                    "vs": cache["vs"].at[:, dest].set(
+                        vs.astype(cache["vs"].dtype)),
+                }
+            else:
+                cache = {
+                    "k": cache["k"].at[:, dest].set(
+                        kp.astype(cache["k"].dtype)),
+                    "v": cache["v"].at[:, dest].set(
+                        vp.astype(cache["v"].dtype)),
+                }
             # Device-side finish state: a slot whose first token already
             # ends it (eos, or max_tokens == 1) never activates.
             active = (remaining > 0) & ~((first_tok == eos) & eos_ok)

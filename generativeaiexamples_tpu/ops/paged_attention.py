@@ -52,7 +52,9 @@ def paged_attention_decode(q: jax.Array, pool_k: jax.Array,
                            lengths: jax.Array, cur_k: jax.Array,
                            cur_v: jax.Array, write_page: jax.Array,
                            write_offset: jax.Array, layer: jax.Array,
-                           *, interpret: bool = False):
+                           *, pool_ks: jax.Array | None = None,
+                           pool_vs: jax.Array | None = None,
+                           interpret: bool = False):
     """GQA decode attention + KV append over a paged pool, one query token
     per slot.
 
@@ -65,13 +67,27 @@ def paged_attention_decode(q: jax.Array, pool_k: jax.Array,
     block_table:  (B, W) int32         physical page of each logical page
     lengths:      (B,) int32           cached tokens per slot (== pos;
                                        current token is NOT in the pool)
-    cur_k/cur_v:  (B, KV, hd)          current token's K/V (pool dtype)
+    cur_k/cur_v:  (B, KV, hd)          current token's K/V (pool dtype,
+                                       or bf16/f32 when the pool is int8 —
+                                       the kernel quantizes on append)
     write_page:   (B,) int32           physical page for the new row
                                        (page 0 = trash, inactive slots)
     write_offset: (B,) int32           row within that page
     layer:        (1,) int32           which layer to read/write
-    Returns (attn (B, H, hd) in q.dtype, new_pool_k, new_pool_v) with the
-    pools aliased in place. Scaling (1/sqrt(hd)) applied here.
+    pool_ks/vs:   (L, N, KV, page)     OPTIONAL per-row scales: presence
+                                       switches the kernel to the int8-KV
+                                       path (ops/kv_quant.py) — int8 pages
+                                       stream at half the HBM bytes, are
+                                       widened to bf16 once in VMEM, and
+                                       the scales fold into scores (K) and
+                                       probabilities (V) around the MXU
+                                       dots; the append quantizes the new
+                                       row in-kernel and writes its scale
+                                       back through the already-streamed
+                                       scale page.
+    Returns (attn (B, H, hd) in q.dtype, new_pool_k, new_pool_v[,
+    new_pool_ks, new_pool_vs]) with the pools aliased in place. Scaling
+    (1/sqrt(hd)) applied here.
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -81,6 +97,12 @@ def paged_attention_decode(q: jax.Array, pool_k: jax.Array,
     W = block_table.shape[1]
     G = H // KV
     scale = hd ** -0.5
+    quant = pool_ks is not None
+    if quant:
+        return _paged_attention_decode_quant(
+            q, pool_k, pool_v, pool_ks, pool_vs, block_table, lengths,
+            cur_k, cur_v, write_page, write_offset, layer,
+            interpret=interpret)
 
     def kernel(tbl_ref, len_ref, wp_ref, off_ref, l_ref, q_ref,
                k_hbm, v_hbm, ck_ref, cv_ref, out_ref, opk_ref, opv_ref,
@@ -229,6 +251,210 @@ def paged_attention_decode(q: jax.Array, pool_k: jax.Array,
         interpret=interpret,
     )(block_table, lengths, write_page, write_offset, layer,
       q, pool_k, pool_v, cur_k, cur_v)
+
+
+def _paged_attention_decode_quant(q, pool_k, pool_v, pool_ks, pool_vs,
+                                  block_table, lengths, cur_k, cur_v,
+                                  write_page, write_offset, layer,
+                                  *, interpret=False):
+    """int8-KV variant of the decode kernel (see paged_attention_decode).
+
+    Same program structure — one program per slot, double-buffered page
+    DMA, online softmax, in-kernel append — with int8 pool pages and a
+    bf16 per-row scale pool (``(L, N, KV, page)``) streamed alongside.
+    HBM page traffic: int8 K+V (half the bf16 bytes) + the scale blocks
+    (~1/128 of the int8 bytes each). The int8->compute-dtype widen
+    happens once per page in VMEM; the MXU dots stay in the query dtype.
+    K scales fold into the scores AFTER the QK^T dot (each K row scales
+    its column of scores); V scales fold INTO the probabilities before
+    the PV dot (each V row scales its contribution).
+
+    The append quantizes the current row in-kernel (symmetric per-row,
+    ops/kv_quant.py semantics: scale cast to bf16 before the divide) and
+    writes the int8 8-row tile the same way as the bf16 kernel. The
+    SCALE write is a full (KV, page) block instead of a tile: the page
+    dim sits on lanes there (so score broadcasting needs no transpose),
+    and lane-dim slices can't DMA — but the block to preserve is already
+    in VMEM (the write page IS the last streamed window page when
+    off > 0; fresh-page rows are garbage that attention masks), so the
+    write-back costs one small extra DMA, not a read-modify-write.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, hd = q.shape
+    L, N, KV, page, _ = pool_k.shape
+    G = H // KV
+    scale = hd ** -0.5
+    cd = q.dtype  # compute dtype for the MXU dots
+
+    def kernel(tbl_ref, len_ref, wp_ref, off_ref, l_ref, q_ref,
+               k_hbm, v_hbm, ks_hbm, vs_hbm, ck_ref, cv_ref,
+               out_ref, opk_ref, opv_ref, opks_ref, opvs_ref,
+               kbuf, vbuf, ksbuf, vsbuf, krw, vrw, ksrw, vsrw,
+               sem, rw_sem):
+        b = pl.program_id(0)
+        li = l_ref[0]
+        length = len_ref[b]
+        n_pages = jax.lax.div(length + (page - 1), page)
+
+        def dma(slot, w, which):
+            hbm, buf = ((k_hbm, kbuf), (v_hbm, vbuf),
+                        (ks_hbm, ksbuf), (vs_hbm, vsbuf))[which]
+            return pltpu.make_async_copy(hbm.at[li, tbl_ref[b, w]],
+                                         buf.at[slot], sem.at[slot, which])
+
+        @pl.when(n_pages > 0)
+        def _():
+            for which in range(4):
+                dma(0, 0, which).start()
+
+        wp = wp_ref[b]
+        qv = q_ref[0].reshape(KV, G, hd)
+
+        def body(w, carry):
+            acc, m, l = carry
+            slot = jax.lax.rem(w, 2)
+            nxt = jax.lax.rem(w + 1, 2)
+
+            @pl.when(w + 1 < n_pages)
+            def _():
+                for which in range(4):
+                    dma(nxt, w + 1, which).start()
+
+            for which in range(4):
+                dma(slot, w, which).wait()
+            kp = kbuf[slot].astype(cd)                         # (KV,page,hd)
+            vp = vbuf[slot].astype(cd)
+            ks = ksbuf[slot].astype(jnp.float32)               # (KV,page)
+            vs = vsbuf[slot].astype(jnp.float32)
+            scores = jax.lax.dot_general(
+                qv, kp, (((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)            # (KV,G,page)
+            scores = scores * ks[:, None, :] * scale
+            valid = (w * page + jax.lax.broadcasted_iota(
+                jnp.int32, (1, 1, page), 2)) < length
+            scores = jnp.where(valid, scores, NEG)
+
+            m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
+            alpha = jnp.exp(m - m_new)
+            p = jnp.exp(scores - m_new)                        # (KV,G,page)
+            l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            pv = jax.lax.dot_general(
+                (p * vs[:, None, :]).astype(cd), vp,
+                (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)            # (KV,G,hd)
+            return acc * alpha + pv, m_new, l_new
+
+        acc0 = jnp.zeros((KV, G, hd), jnp.float32)
+        m0 = jnp.full((KV, G, 1), NEG, jnp.float32)
+        l0 = jnp.zeros((KV, G, 1), jnp.float32)
+        acc, m, l = jax.lax.fori_loop(0, n_pages, body, (acc0, m0, l0))
+
+        # Current token folds in exact (unquantized), as in the bf16 kernel.
+        ck = ck_ref[0].astype(jnp.float32)                     # (KV,hd)
+        cv = cv_ref[0].astype(jnp.float32)
+        s_cur = jnp.sum(qv.astype(jnp.float32) * ck[:, None, :],
+                        axis=-1, keepdims=True) * scale        # (KV,G,1)
+        m2 = jnp.maximum(m, s_cur)
+        a = jnp.exp(m - m2)
+        bta = jnp.exp(s_cur - m2)
+        out = acc * a + cv[:, None, :] * bta
+        denom = l * a + bta
+        out_ref[0] = (out / denom).reshape(H, hd).astype(out_ref.dtype)
+
+        # Append: quantize the new row per kv head (kv_quant semantics —
+        # the stored bf16 scale is the one used for the divide).
+        def rowq(x):
+            amax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)  # (KV,1)
+            s = (jnp.maximum(amax, 1e-8) / 127.0).astype(jnp.bfloat16)
+            qr = jnp.clip(jnp.round(x / s.astype(jnp.float32)),
+                          -127.0, 127.0).astype(jnp.int8)
+            return qr, s[:, 0]                                  # (KV,hd),(KV,)
+
+        k_int, k_s = rowq(ck)
+        v_int, v_s = rowq(cv)
+        off = off_ref[b]
+        tile0 = (off // _TILE) * _TILE
+        last = jnp.maximum(n_pages - 1, 0)
+        lslot = jax.lax.rem(last, 2)
+        src_k = kbuf[lslot, :, pl.ds(tile0, _TILE), :]
+        src_v = vbuf[lslot, :, pl.ds(tile0, _TILE), :]
+        row_mask = jax.lax.broadcasted_iota(
+            jnp.int32, (1, _TILE, 1), 1) == (off - tile0)
+        krw[:] = jnp.where(row_mask, k_int[:, None, :], src_k)
+        vrw[:] = jnp.where(row_mask, v_int[:, None, :], src_v)
+        # Scale block: lane `off` takes the new scale, every other lane
+        # keeps the streamed page's value (garbage on a fresh page — rows
+        # >= length are never attended).
+        lane = jax.lax.broadcasted_iota(jnp.int32, (1, page), 1) == off
+        ksrw[:] = jnp.where(lane, k_s[:, None].astype(jnp.bfloat16),
+                            ksbuf[lslot])
+        vsrw[:] = jnp.where(lane, v_s[:, None].astype(jnp.bfloat16),
+                            vsbuf[lslot])
+        writes = [
+            pltpu.make_async_copy(
+                krw, opk_ref.at[li, wp, :, pl.ds(tile0, _TILE)],
+                rw_sem.at[0]),
+            pltpu.make_async_copy(
+                vrw, opv_ref.at[li, wp, :, pl.ds(tile0, _TILE)],
+                rw_sem.at[1]),
+            pltpu.make_async_copy(ksrw, opks_ref.at[li, wp], rw_sem.at[2]),
+            pltpu.make_async_copy(vsrw, opvs_ref.at[li, wp], rw_sem.at[3]),
+        ]
+        for wcp in writes:
+            wcp.start()
+        for wcp in writes:
+            wcp.wait()
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,   # table, lengths, write page/offset, layer
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, H, hd), lambda b, *_: (b, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),   # K pool (int8, HBM)
+            pl.BlockSpec(memory_space=pltpu.ANY),   # V pool (int8, HBM)
+            pl.BlockSpec(memory_space=pltpu.ANY),   # K scales (HBM)
+            pl.BlockSpec(memory_space=pltpu.ANY),   # V scales (HBM)
+            pl.BlockSpec((1, KV, hd), lambda b, *_: (b, 0, 0)),
+            pl.BlockSpec((1, KV, hd), lambda b, *_: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, H, hd), lambda b, *_: (b, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, KV, page, hd), pool_k.dtype),
+            pltpu.VMEM((2, KV, page, hd), pool_v.dtype),
+            pltpu.VMEM((2, KV, page), pool_ks.dtype),
+            pltpu.VMEM((2, KV, page), pool_vs.dtype),
+            pltpu.VMEM((KV, _TILE, hd), pool_k.dtype),
+            pltpu.VMEM((KV, _TILE, hd), pool_v.dtype),
+            pltpu.VMEM((KV, page), pool_ks.dtype),
+            pltpu.VMEM((KV, page), pool_vs.dtype),
+            pltpu.SemaphoreType.DMA((2, 4)),
+            pltpu.SemaphoreType.DMA((4,)),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, hd), q.dtype),
+            jax.ShapeDtypeStruct(pool_k.shape, pool_k.dtype),
+            jax.ShapeDtypeStruct(pool_v.shape, pool_v.dtype),
+            jax.ShapeDtypeStruct(pool_ks.shape, pool_ks.dtype),
+            jax.ShapeDtypeStruct(pool_vs.shape, pool_vs.dtype),
+        ],
+        # operands: tbl=0, lens=1, wp=2, off=3, layer=4, q=5, pool_k=6,
+        # pool_v=7, pool_ks=8, pool_vs=9, ck=10, cv=11
+        input_output_aliases={6: 1, 7: 2, 8: 3, 9: 4},
+        interpret=interpret,
+    )(block_table, lengths, write_page, write_offset, layer,
+      q, pool_k, pool_v, pool_ks, pool_vs, cur_k, cur_v)
 
 
 def paged_attention_decode_reference(q, pool_k, pool_v, block_table,

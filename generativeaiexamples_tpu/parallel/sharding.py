@@ -107,17 +107,23 @@ def kv_cache_spec(cfg: LlamaConfig, mesh: Mesh) -> Specs:
     return {"k": spec, "v": spec}
 
 
-def paged_kv_cache_spec(cfg: LlamaConfig, mesh: Mesh) -> Specs:
+def paged_kv_cache_spec(cfg: LlamaConfig, mesh: Mesh,
+                        quantized: bool = False) -> Specs:
     """Paged cache (L, N, KV, page, hd): KV heads over tp, pages replicated.
 
     The page pool has no batch axis (slots share it through block tables),
     so dp does not appear; layers shard over pp like the params.
+    int8-KV mode adds per-row scale pools (L, N, KV, page) — same sharding
+    minus the head dim (ops/kv_quant.py).
     """
     tp = _axis_on(mesh, "tp")
     pp = _axis_on(mesh, "pp")
     kv_tp = tp if tp and cfg.num_kv_heads % mesh.shape["tp"] == 0 else None
     spec = P(pp, None, kv_tp, None, None)
-    return {"k": spec, "v": spec}
+    specs = {"k": spec, "v": spec}
+    if quantized:
+        specs["ks"] = specs["vs"] = P(pp, None, kv_tp, None)
+    return specs
 
 
 def activation_spec(mesh: Mesh) -> P:

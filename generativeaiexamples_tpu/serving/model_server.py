@@ -155,7 +155,7 @@ def build_services(model_type: str = "dev", model_name: str = "",
                    max_slots: int = 8, dtype: str = "bfloat16",
                    quantization: str = "", with_embedder: bool = True,
                    seed: int = 0, max_prefill_bucket: Optional[int] = None,
-                   page_size: int = 0):
+                   page_size: int = 0, kv_quant: str = ""):
     """Create (engine, embed_service, model_name) per the CLI/config."""
     import jax
     import jax.numpy as jnp
@@ -182,7 +182,7 @@ def build_services(model_type: str = "dev", model_name: str = "",
         max_slots=max_slots, max_input_length=max_input_length,
         max_output_length=max_output_length, dtype=dtype, seed=seed,
         max_prefill_bucket=max_prefill_bucket,
-        page_size=page_size or EngineConfig.page_size)
+        page_size=page_size or EngineConfig.page_size, kv_quant=kv_quant)
 
     world, tp, pp = resolve_topology(world_size, tp, pp)
     mesh = make_mesh(MeshPlan(tp=tp, pp=pp), jax.devices()[:world]) \
@@ -440,6 +440,9 @@ def main(argv: Optional[list[str]] = None) -> None:
     parser.add_argument("--pipeline-parallelism", type=int, default=1)
     parser.add_argument("--quantization", default="",
                         choices=["", "int8", "int4", "int4_awq"])
+    parser.add_argument("--kv-quant", default="", choices=["", "int8"],
+                        help="KV-cache quantization: int8 pool pages + "
+                             "per-row scales (~2x pages at fixed HBM)")
     parser.add_argument("--max-input-length", type=int, default=3000)
     parser.add_argument("--max-prefill-bucket", type=int, default=0,
                         help="cap the one-shot prefill bucket; longer "
@@ -482,7 +485,7 @@ def main(argv: Optional[list[str]] = None) -> None:
         max_slots=args.max_batch_size, dtype=args.dtype,
         with_embedder=not args.no_embedder,
         max_prefill_bucket=args.max_prefill_bucket or None,
-        page_size=args.page_size)
+        page_size=args.page_size, kv_quant=args.kv_quant)
     engine.start()
     grpc_server = None  # keep the reference: grpc.Server stops when GC'd
     if args.grpc_port:

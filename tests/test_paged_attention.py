@@ -93,6 +93,93 @@ def test_kernel_writes_row_in_place():
     np.testing.assert_array_equal(nv[keep], before_v[keep])
 
 
+def _quantize_pools(pk, pv):
+    from generativeaiexamples_tpu.ops.kv_quant import quantize_rows
+    kq, ks = quantize_rows(pk)
+    vq, vs = quantize_rows(pv)
+    return kq, vq, ks, vs
+
+
+@pytest.mark.parametrize("B,H,W,lengths", [
+    (2, 8, 2, [20, 32]),
+    (4, 8, 3, [5, 20, 33, 0]),     # ragged, incl. zero cached tokens
+])
+def test_quant_kernel_matches_dequant_oracle(B, H, W, lengths):
+    """int8-KV kernel == full-precision oracle run on the DEQUANTIZED
+    pools (the quantization error itself is covered separately) — the
+    kernel's scale folding introduces no additional error."""
+    from generativeaiexamples_tpu.ops.kv_quant import dequantize_rows
+    q, pk, pv, table, lens, ck, cv = _setup(B, H, W, lengths)
+    kq, vq, ks, vs = _quantize_pools(pk, pv)
+    wp = jnp.zeros((B,), jnp.int32)
+    off = lens % page
+    layer = jnp.zeros((1,), jnp.int32)
+    ref = paged_attention_decode_reference(
+        q, dequantize_rows(kq, ks, jnp.float32)[0],
+        dequantize_rows(vq, vs, jnp.float32)[0], table, lens, ck, cv)
+    out, *_ = paged_attention_decode(q, kq, vq, table, lens, ck, cv,
+                                     wp, off, layer, pool_ks=ks,
+                                     pool_vs=vs, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_quant_kernel_append_row_and_scale():
+    """The int8 append: new row quantized in-kernel with kv_quant
+    semantics, its scale written through the streamed scale page, live
+    rows + scales preserved, everything else untouched."""
+    from generativeaiexamples_tpu.ops.kv_quant import quantize_rows
+    B, H, W = 3, 8, 3
+    lengths = [20, 33, 16]                   # offs 4, 1, 0 (fresh page)
+    q, pk, pv, table, lens, ck, cv = _setup(B, H, W, lengths)
+    kq, vq, ks, vs = _quantize_pools(pk, pv)
+    tbl = np.asarray(table)
+    wp = jnp.asarray([tbl[b, lengths[b] // page] for b in range(B)],
+                     jnp.int32)
+    off = lens % page
+    layer = jnp.ones((1,), jnp.int32)
+    before = [np.asarray(x) for x in (kq, vq, ks, vs)]
+    _, nk, nv, nks, nvs = paged_attention_decode(
+        q, kq, vq, table, lens, ck, cv, wp, off, layer,
+        pool_ks=ks, pool_vs=vs, interpret=True)
+    nk, nv, nks, nvs = (np.asarray(x) for x in (nk, nv, nks, nvs))
+    for b in range(B):
+        w, o = int(wp[b]), int(off[b])
+        ek, es = quantize_rows(ck[b])
+        np.testing.assert_array_equal(nk[1, w, :, o, :], np.asarray(ek))
+        np.testing.assert_array_equal(
+            nks[1, w, :, o].astype(np.float32),
+            np.asarray(es).astype(np.float32))
+        ev, evs = quantize_rows(cv[b])
+        np.testing.assert_array_equal(nv[1, w, :, o, :], np.asarray(ev))
+        np.testing.assert_array_equal(
+            nvs[1, w, :, o].astype(np.float32),
+            np.asarray(evs).astype(np.float32))
+        # live rows + their scales below the new row survive
+        t0 = o // 8 * 8
+        np.testing.assert_array_equal(nk[1, w, :, t0:o, :],
+                                      before[0][1, w, :, t0:o, :])
+        np.testing.assert_array_equal(nks[1, w, :, :o],
+                                      before[2][1, w, :, :o])
+    # scale pages not written this step are untouched
+    keep = np.ones(nks.shape, bool)
+    for b in range(B):
+        keep[1, int(wp[b])] = False
+    np.testing.assert_array_equal(nks[keep], before[2][keep])
+    np.testing.assert_array_equal(nvs[keep], before[3][keep])
+
+
+def test_kv_quant_roundtrip_error_bound():
+    """Per-row int8 quantization keeps relative row error ~<1%."""
+    from generativeaiexamples_tpu.ops.kv_quant import (dequantize_rows,
+                                                       quantize_rows)
+    x = jax.random.normal(jax.random.key(3), (4, 16, 64), jnp.float32) * 5
+    qx, s = quantize_rows(x)
+    back = dequantize_rows(qx, s, jnp.float32)
+    rel = np.abs(np.asarray(back - x)).max() / np.abs(np.asarray(x)).max()
+    assert rel < 0.02, rel
+
+
 def test_kernel_supported_gate():
     assert kernel_supported(128, 32, 32, 128)
     assert not kernel_supported(128, 32, 32, 64)   # hd not lane-width

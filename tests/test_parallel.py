@@ -211,3 +211,42 @@ def test_engine_tp_mesh_kernel_path_parity(cpu_devices, monkeypatch):
     mesh_pp = make_mesh(MeshPlan(pp=2, tp=2), jax.devices()[:4])
     eng_pp = Engine(params, kcfg, tok, ecfg, mesh=mesh_pp)
     assert not eng_pp._use_kernel
+
+
+def test_engine_tp_mesh_int8_kv_kernel(cpu_devices, monkeypatch):
+    """int8-KV under a tp mesh: the shard_mapped quant kernel (scale
+    pools sharded over kv heads with their int8 pools) serves and matches
+    the single-device int8 gather path exactly — same quantized pool
+    contents, same greedy tokens."""
+    from generativeaiexamples_tpu.engine import (Engine, EngineConfig,
+                                                 SamplingParams)
+    from generativeaiexamples_tpu.models.configs import LlamaConfig
+    from generativeaiexamples_tpu.models.tokenizer import ByteTokenizer
+
+    kcfg = LlamaConfig(vocab_size=320, hidden_size=64,
+                       intermediate_size=96, num_layers=2, num_heads=4,
+                       num_kv_heads=2, head_dim=128,
+                       max_position_embeddings=1024)
+    params = llama.init_params(kcfg, jax.random.key(11), dtype=jnp.float32)
+    ecfg = EngineConfig(max_slots=2, max_input_length=128,
+                        max_output_length=32, prefill_buckets=(128,),
+                        dtype="float32", page_size=128,
+                        kv_pool_tokens=1024, steps_per_round=4,
+                        kv_quant="int8")
+    tok = ByteTokenizer()
+    sp = SamplingParams(max_tokens=6, top_k=1, ignore_eos=True)
+    prompt = tok.encode("int8 kv under tp")
+
+    monkeypatch.setenv("GENAI_TPU_PAGED_KERNEL", "0")
+    with Engine(params, kcfg, tok, ecfg) as ref_eng:
+        ref = ref_eng.submit(prompt, sp)
+        ref.text()
+
+    monkeypatch.setenv("GENAI_TPU_PAGED_KERNEL", "1")
+    mesh = make_mesh(MeshPlan(tp=2), jax.devices()[:2])
+    with Engine(params, kcfg, tok, ecfg, mesh=mesh) as eng:
+        assert eng._use_kernel
+        assert eng._state["cache"]["ks"].shape[-2] == 2  # KV dim sharded spec
+        got = eng.submit(prompt, sp)
+        got.text()
+    assert got.token_ids == ref.token_ids
