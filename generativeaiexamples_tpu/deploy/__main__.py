@@ -7,12 +7,17 @@ deploy/k8s-operator/kube-trailblazer/main.go):
             Render a chart to stdout (the ``helm template`` equivalent).
   reconcile -f pipeline.yaml [--charts PATH] [--dry-run]
             One reconcile pass of a HelmPipeline manifest.
-  watch     [--charts PATH] [--interval SECONDS]
+  watch     [--charts PATH] [--interval SECONDS] [--client kubectl|api]
+            [--leader-elect] [--identity NAME]
             Controller loop: stream HelmPipeline watch events from the
-            apiserver (``kubectl get --watch --output-watch-events``),
-            reconcile on ADDED/MODIFIED, drain on DELETED, with a full
-            list+reconcile resync every --interval seconds (requeue of
-            errored pipelines comes free from the resync).
+            apiserver (default: ``kubectl get --watch``; ``--client api``
+            streams ``?watch=1`` over direct HTTPS with the in-cluster
+            service account — no kubectl binary needed), reconcile on
+            ADDED/MODIFIED, drain on DELETED, with a full list+reconcile
+            resync every --interval seconds (requeue of errored pipelines
+            comes free from the resync). ``--leader-elect`` gates the
+            loop behind a coordination.k8s.io Lease so replicas can run
+            active/standby (deploy/leader.py).
   install-crd
             kubectl-apply the HelmPipeline CRD.
 """
@@ -61,13 +66,13 @@ def _cmd_reconcile(args) -> int:
     return 1 if result.error else 0
 
 
-def _resync(kube, op) -> None:
-    proc = kube._run(["get", "helmpipelines", "-A", "-o", "json"])
-    if proc.returncode != 0:
-        print(f"list helmpipelines failed: {proc.stderr.strip()}",
-              file=sys.stderr)
+def _resync(list_pipelines, op) -> None:
+    try:
+        items = list_pipelines()
+    except Exception as exc:  # noqa: BLE001 — transient apiserver trouble
+        print(f"list helmpipelines failed: {exc}", file=sys.stderr)
         return
-    for item in json.loads(proc.stdout).get("items", []):
+    for item in items:
         pipeline = HelmPipeline.from_manifest(item)
         result = op.reconcile(pipeline)
         if result.error:
@@ -75,64 +80,118 @@ def _resync(kube, op) -> None:
                   file=sys.stderr)
 
 
-def _cmd_watch(args) -> int:
+def _handle_event(op, event: dict) -> None:
+    etype = event.get("type", "MODIFIED")
+    pipeline = HelmPipeline.from_manifest(event.get("object", {}))
+    if not pipeline.name:
+        return
+    if etype == "DELETED":
+        n = op.delete(pipeline)
+        print(f"deleted {pipeline.name}: drained {n} objects",
+              file=sys.stderr)
+    else:
+        result = op.reconcile(pipeline)
+        if result.error:
+            print(f"reconcile {pipeline.name}: requeue "
+                  f"({result.error})", file=sys.stderr)
+
+
+def _watch_once_kubectl(kube, op, interval: int) -> None:
+    """One watch window via a kubectl subprocess pipe (the driver-binary
+    path; the --client api path needs no binary at all)."""
     import subprocess
+    import threading
 
     from .kube import iter_json_stream
 
-    kube = KubectlKube()
+    proc = subprocess.Popen(
+        [kube.kubectl, "get", "helmpipelines", "-A", "--watch",
+         "--output-watch-events", "-o", "json"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+    # A quiet watch blocks in readline forever; the timer tears the
+    # session down at the resync deadline so the outer loop's full
+    # resync is never starved.
+    timer = threading.Timer(interval, proc.terminate)
+    timer.daemon = True
+    timer.start()
+    try:
+        def chunks():
+            while True:
+                line = proc.stdout.readline()
+                if not line:
+                    return
+                yield line
+        for event in iter_json_stream(chunks()):
+            _handle_event(op, event)
+    finally:
+        timer.cancel()
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            # kubectl wedged past SIGTERM (dead TCP, uninterruptible
+            # I/O) — kill it rather than dying with it
+            proc.kill()
+            proc.wait(timeout=10)
+
+
+def _cmd_watch(args) -> int:
+    from .types import API_VERSION
+    api_version = API_VERSION
+
+    if args.client == "api":
+        from .apiserver import ApiServerKube
+        kube = ApiServerKube()
+        list_pipelines = lambda: kube.list_resources(  # noqa: E731
+            api_version, "HelmPipeline")
+        watch_once = lambda: _watch_once_api_stream(  # noqa: E731
+            kube, op, api_version, args.interval)
+    else:
+        kube = KubectlKube()
+
+        def list_pipelines():
+            proc = kube._run(["get", "helmpipelines", "-A", "-o", "json"])
+            if proc.returncode != 0:
+                raise RuntimeError(proc.stderr.strip())
+            return json.loads(proc.stdout).get("items", [])
+
+        watch_once = lambda: _watch_once_kubectl(  # noqa: E731
+            kube, op, args.interval)
+
     op = PipelineOperator(kube, chart_search_path=args.charts)
-    while True:
+
+    def one_cycle():
         # Full resync first (startup + every reconnect): catches CRs whose
         # events were missed while the watch was down, and re-runs errored
         # pipelines — the controller-runtime resync analogue.
-        _resync(kube, op)
         deadline = time.time() + args.interval
-        proc = subprocess.Popen(
-            [kube.kubectl, "get", "helmpipelines", "-A", "--watch",
-             "--output-watch-events", "-o", "json"],
-            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
-        # A quiet watch blocks in readline forever; the timer tears the
-        # session down at the resync deadline so the outer loop's full
-        # resync is never starved.
-        import threading
-        timer = threading.Timer(args.interval, proc.terminate)
-        timer.daemon = True
-        timer.start()
-        try:
-            def chunks():
-                while True:
-                    line = proc.stdout.readline()
-                    if not line:
-                        return
-                    yield line
-            for event in iter_json_stream(chunks()):
-                etype = event.get("type", "MODIFIED")
-                pipeline = HelmPipeline.from_manifest(
-                    event.get("object", {}))
-                if not pipeline.name:
-                    continue
-                if etype == "DELETED":
-                    n = op.delete(pipeline)
-                    print(f"deleted {pipeline.name}: drained {n} objects",
-                          file=sys.stderr)
-                else:
-                    result = op.reconcile(pipeline)
-                    if result.error:
-                        print(f"reconcile {pipeline.name}: requeue "
-                              f"({result.error})", file=sys.stderr)
-        finally:
-            timer.cancel()
-            proc.terminate()
-            try:
-                proc.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                # kubectl wedged past SIGTERM (dead TCP, uninterruptible
-                # I/O) — kill it rather than dying with it
-                proc.kill()
-                proc.wait(timeout=10)
-        # loop -> resync + fresh watch (also bounds a wedged kubectl)
+        _resync(list_pipelines, op)
+        watch_once()
         time.sleep(max(0.0, deadline - time.time()))
+
+    if args.leader_elect:
+        from .leader import LeaderElector
+        identity = args.identity or f"{os.uname().nodename}-{os.getpid()}"
+        elector = LeaderElector(kube, identity,
+                                namespace=args.lease_namespace)
+        print(f"leader election on ({identity})", file=sys.stderr)
+        elector.run(one_cycle, renew_seconds=min(5.0, args.interval / 2))
+        return 0
+    while True:
+        one_cycle()
+
+
+def _watch_once_api_stream(kube, op, api_version: str,
+                           interval: int) -> None:
+    """One watch window over direct apiserver HTTPS (?watch=1 stream);
+    the server closes the window after ``interval`` seconds, which is
+    the outer loop's natural resync point."""
+    try:
+        for event in kube.watch(api_version, "HelmPipeline",
+                                timeout_seconds=interval):
+            _handle_event(op, event)
+    except Exception as exc:  # noqa: BLE001 — reconnect via outer loop
+        print(f"watch stream ended: {exc}", file=sys.stderr)
 
 
 def _cmd_install_crd(args) -> int:
@@ -163,6 +222,17 @@ def main(argv=None) -> int:
     p = sub.add_parser("watch")
     p.add_argument("--charts", default="/opt/charts")
     p.add_argument("--interval", type=int, default=30)
+    p.add_argument("--client", choices=["kubectl", "api"],
+                   default="kubectl",
+                   help="apiserver transport: kubectl subprocess pipe, or "
+                        "direct in-cluster HTTPS (no binary)")
+    p.add_argument("--leader-elect", action="store_true",
+                   help="gate the loop behind a coordination.k8s.io "
+                        "Lease (active/standby replicas)")
+    p.add_argument("--identity", default="",
+                   help="holder identity for --leader-elect "
+                        "(default: hostname-pid)")
+    p.add_argument("--lease-namespace", default="kube-system")
     p.set_defaults(fn=_cmd_watch)
 
     p = sub.add_parser("install-crd")

@@ -155,6 +155,10 @@ class KubectlKube(KubeInterface):
     def apply(self, obj: dict) -> None:
         proc = self._run(["apply", "-f", "-"], stdin=json.dumps(obj))
         if proc.returncode != 0:
+            if "Operation cannot be fulfilled" in proc.stderr:
+                # optimistic-concurrency 409 — callers (leader election)
+                # handle this as a lost race, not a crash
+                raise ConflictError(proc.stderr)
             raise RuntimeError(f"kubectl apply failed: {proc.stderr}")
 
     def get(self, key: ObjKey) -> Optional[dict]:

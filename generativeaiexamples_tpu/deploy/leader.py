@@ -1,0 +1,173 @@
+"""Lease-based leader election for the operator.
+
+The reference's manager gets leader election from controller-runtime
+(reference: deploy/k8s-operator/kube-trailblazer/main.go — the
+``ctrl.NewManager`` options carry the election toggles); this is the
+same coordination.k8s.io/v1 Lease protocol over the repo's
+``KubeInterface``:
+
+- a single ``Lease`` object names the active holder
+  (``spec.holderIdentity``) and its expiry window
+  (``renewTime + leaseDurationSeconds``);
+- acquiring means writing the Lease CARRYING the observed
+  ``resourceVersion`` — optimistic concurrency makes simultaneous
+  takeovers race safely (the loser's write raises ``ConflictError``);
+- the holder renews within the window; a crashed holder's lease simply
+  expires and the next candidate takes over.
+
+The protocol needs only apply/get, so it runs against any
+``KubeInterface`` — including ``InMemoryKube``, whose resourceVersion
+conflicts make the race paths unit-testable without a cluster.
+"""
+
+from __future__ import annotations
+
+import datetime
+import time
+from typing import Callable, Optional
+
+from .kube import ConflictError, KubeInterface, ObjKey
+
+LEASE_API = "coordination.k8s.io/v1"
+
+
+def _now() -> datetime.datetime:
+    return datetime.datetime.now(datetime.timezone.utc)
+
+
+def _fmt(ts: datetime.datetime) -> str:
+    # MicroTime, the Lease spec's timestamp format
+    return ts.strftime("%Y-%m-%dT%H:%M:%S.%f") + "Z"
+
+
+def _parse(ts: str) -> Optional[datetime.datetime]:
+    for fmt in ("%Y-%m-%dT%H:%M:%S.%fZ", "%Y-%m-%dT%H:%M:%SZ"):
+        try:
+            return datetime.datetime.strptime(ts, fmt).replace(
+                tzinfo=datetime.timezone.utc)
+        except ValueError:
+            continue
+    return None
+
+
+class LeaderElector:
+    """Acquire/renew a Lease; callbacks fire on gain/loss.
+
+    ``lease_seconds`` is the validity window; renewals should happen at
+    ``renew_seconds`` (< lease_seconds) intervals. One elector instance
+    per candidate process.
+    """
+
+    def __init__(self, kube: KubeInterface, identity: str,
+                 name: str = "tpu-llm-operator",
+                 namespace: str = "kube-system",
+                 lease_seconds: int = 15,
+                 clock: Callable[[], datetime.datetime] = _now):
+        self.kube = kube
+        self.identity = identity
+        self.key: ObjKey = (LEASE_API, "Lease", namespace, name)
+        self.lease_seconds = lease_seconds
+        self.is_leader = False
+        self._clock = clock
+
+    # ------------------------------------------------------------ protocol
+
+    def _lease_obj(self, current: Optional[dict]) -> dict:
+        meta: dict = {"name": self.key[3], "namespace": self.key[2]}
+        if current is not None:
+            rv = current.get("metadata", {}).get("resourceVersion")
+            if rv is not None:
+                meta["resourceVersion"] = rv  # optimistic-concurrency guard
+        transitions = 0
+        if current is not None:
+            spec = current.get("spec", {})
+            transitions = int(spec.get("leaseTransitions") or 0)
+            if spec.get("holderIdentity") not in (None, "", self.identity):
+                transitions += 1
+        return {
+            "apiVersion": LEASE_API, "kind": "Lease", "metadata": meta,
+            "spec": {
+                "holderIdentity": self.identity,
+                "leaseDurationSeconds": self.lease_seconds,
+                "renewTime": _fmt(self._clock()),
+                "leaseTransitions": transitions,
+            },
+        }
+
+    def _expired(self, lease: dict) -> bool:
+        spec = lease.get("spec", {})
+        renew = _parse(str(spec.get("renewTime", "")))
+        if renew is None:
+            return True
+        dur = int(spec.get("leaseDurationSeconds") or self.lease_seconds)
+        return self._clock() > renew + datetime.timedelta(seconds=dur)
+
+    def try_acquire(self) -> bool:
+        """One acquisition/renewal attempt; returns current leadership."""
+        current = self.kube.get(self.key)
+        holder = (current or {}).get("spec", {}).get("holderIdentity")
+        if current is not None and holder not in (None, "", self.identity) \
+                and not self._expired(current):
+            self.is_leader = False
+            return False
+        try:
+            self.kube.apply(self._lease_obj(current))
+        except ConflictError:
+            # lost the takeover race; the winner's renewTime governs now
+            self.is_leader = False
+            return False
+        self.is_leader = True
+        return True
+
+    def release(self) -> None:
+        """Drop the lease on clean shutdown so the next candidate need
+        not wait out the expiry window."""
+        if not self.is_leader:
+            return
+        current = self.kube.get(self.key)
+        if current is not None and current.get("spec", {}).get(
+                "holderIdentity") == self.identity:
+            obj = self._lease_obj(current)
+            obj["spec"]["holderIdentity"] = ""
+            try:
+                self.kube.apply(obj)
+            except ConflictError:
+                pass  # someone already took it; nothing to release
+        self.is_leader = False
+
+    # ------------------------------------------------------------ run loop
+
+    def run(self, while_leading: Callable[[], None],
+            renew_seconds: float = 5.0,
+            retry_seconds: float = 2.0,
+            stop: Optional[Callable[[], bool]] = None) -> None:
+        """Block until leadership, then call ``while_leading()`` in a
+        loop while a BACKGROUND thread renews the lease every
+        ``renew_seconds`` — the callback may block for a full
+        watch/resync window (typically longer than the lease duration),
+        and without concurrent renewal every cycle would expire the
+        lease mid-reconcile and hand a standby a split brain. A failed
+        renewal drops ``is_leader``; the loop stops invoking the
+        callback after the cycle in flight and returns to candidacy."""
+        import threading
+        try:
+            while not (stop and stop()):
+                if not self.try_acquire():
+                    time.sleep(retry_seconds)
+                    continue
+                done = threading.Event()
+
+                def renew() -> None:
+                    while not done.wait(renew_seconds):
+                        if not self.try_acquire():
+                            return  # is_leader already False
+                renewer = threading.Thread(target=renew, daemon=True)
+                renewer.start()
+                try:
+                    while self.is_leader and not (stop and stop()):
+                        while_leading()
+                finally:
+                    done.set()
+                    renewer.join(timeout=renew_seconds + 1)
+        finally:
+            self.release()

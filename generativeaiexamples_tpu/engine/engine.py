@@ -433,9 +433,15 @@ class Engine:
         ("v4", 32 << 30), ("v3", 32 << 30), ("v2", 16 << 30),
     )
 
-    def _kv_bytes_per_token(self) -> int:
+    def _kv_bytes_per_token(self, pooled: bool = True) -> int:
+        """KV bytes per cached token. ``pooled``: bytes in the page pool
+        (int8 + bf16 scales under kv_quant); False: the DENSE bytes of
+        prefill-bucket KV, which stays at the compute dtype — the
+        quantization happens at insert, so sizing the prefill headroom
+        with pooled bytes would under-reserve by ~2x in quant mode (the
+        r5 32-slot OOM)."""
         mcfg = self.model_cfg
-        if self._kv_quant:
+        if pooled and self._kv_quant:
             # int8 K+V rows + one bf16 scale each (ops/kv_quant.py)
             return (mcfg.num_layers * mcfg.num_kv_heads
                     * 2 * (mcfg.head_dim + 2))
@@ -485,6 +491,14 @@ class Engine:
                          None)
             if total is None:
                 return None
+            # No memory_stats => tunneled runtime: its reserves measure
+            # ~2.5-3 GB beyond the usual runtime slice (r5 ceiling probes:
+            # ~11.5 GB of 16 GB actually serveable), and a serving OOM is
+            # unrecoverable in-process (see _probe_pool_pages) — so the
+            # blind-estimate path takes the deep haircut. Deployments
+            # needing every page pin kv_pool_tokens explicitly, the way
+            # the reference hand-tunes kv_cache_free_gpu_mem_fraction.
+            total = int(total * 0.87)
             live = 0
             for a in jax.live_arrays():
                 try:
@@ -512,7 +526,7 @@ class Engine:
         HBM the first dispatch then fights over (round-2 bench OOM)."""
         cfg, mcfg = self.cfg, self.model_cfg
         S = max(self._buckets)
-        bucket_cache = S * self._kv_bytes_per_token()
+        bucket_cache = S * self._kv_bytes_per_token(pooled=False)
         logits = S * mcfg.vocab_size * 4
         acts = S * mcfg.hidden_size * 64
         # The gathered page window only exists on the jnp fallback path;
@@ -522,7 +536,16 @@ class Engine:
         gather = 0 if self._use_kernel else (
             cfg.max_slots * self._pmax * cfg.page_size
             * mcfg.num_kv_heads * mcfg.head_dim * 2 * self._dtype.itemsize)
-        return 3 * bucket_cache + logits + acts + gather + (256 << 20)
+        # int8-KV insert quantizes the bucket per-row; XLA sequences the
+        # K and V transforms, so ~one bucket's f32 copy is live at once
+        quant = bucket_cache if self._kv_quant else 0
+        # 1.5x the bucket cache: the cache itself plus in-flight copy
+        # slack at the prefill->insert overlap. (The former 3x model,
+        # cross-checked against r5's measured serving ceilings, over-
+        # reserved by ~2 GB at a 2048 bucket and floor-collapsed the
+        # auto pool when an embedder shared the chip.)
+        return int(1.5 * bucket_cache) + logits + acts + gather + quant \
+            + (256 << 20)
 
     def _resolve_pool_pages(self) -> int:
         cfg = self.cfg
@@ -539,9 +562,52 @@ class Engine:
         free = self._free_hbm_bytes()
         if free is None:
             return full
-        budget = int((free - self._headroom_bytes()) * 0.9)
+        # Safety multiplier on the post-headroom budget. Quant mode runs
+        # 0.8: its serving peak was measured ~1.5 GB past the modeled
+        # headroom on v5e (r5: estimate said 141+ pages, the true ceiling
+        # sat between 130 and 150), and on tunneled backends one serving
+        # OOM is unrecoverable in-process — see _probe_pool_pages.
+        margin = 0.8 if self._kv_quant else 0.9
+        budget = int((free - self._headroom_bytes()) * margin)
         pages = budget // (cfg.page_size * self._kv_bytes_per_token())
-        return min(full, max(self._pmax, pages))
+        return self._probe_pool_pages(min(full, max(self._pmax, pages)))
+
+    def _probe_pool_pages(self, pages: int) -> int:
+        """Validate an estimated pool size by ACTUALLY allocating (and
+        freeing) pool-plus-headroom bytes before the pool exists.
+
+        The estimate can overshoot (tunneled devices report no
+        memory_stats), and on this backend a mid-serving OOM is
+        unrecoverable in-process: buffers freed afterward never return to
+        the allocator, so prewarm's shrink-retry can only rescue healthy
+        backends (measured r5: after one serving OOM, even a 3.4 GB
+        allocation fails forever while live arrays total 6.9/16 GB). A
+        FAILED plain allocation leaks nothing — no program ran — so
+        probing first converges to a safe size without ever poisoning the
+        device. The probe is one contiguous array, slightly conservative
+        vs the fragmented real peak."""
+        cfg = self.cfg
+        page_bytes = cfg.page_size * self._kv_bytes_per_token()
+        shard = self._pool_shard_factor()
+        head = self._headroom_bytes()
+        floor = self._pmax
+        while pages > floor:
+            want = pages * page_bytes // shard + head
+            try:
+                probe = jnp.zeros((want,), jnp.int8)
+                jax.block_until_ready(probe)
+                del probe
+                return pages
+            except Exception as exc:  # noqa: BLE001 — filtered below
+                if "RESOURCE_EXHAUSTED" not in str(exc):
+                    return pages
+                import sys as _sys
+                shrunk = max(floor, int(pages * 0.85))
+                _sys.stderr.write(
+                    f"engine pool probe: {pages} pages + headroom does "
+                    f"not allocate; trying {shrunk}\n")
+                pages = shrunk
+        return pages
 
     def prewarm(self, max_retries: int = 4) -> None:
         """Verify the pool sizing by actually SERVING a worst-case dummy
@@ -560,6 +626,15 @@ class Engine:
             raise EngineError("prewarm() requires a stopped engine")
         for attempt in range(max_retries + 1):
             try:
+                if attempt:
+                    # Rebuild at the shrunken size INSIDE the try: the
+                    # rebuild's own allocations can OOM too (old donated
+                    # buffers may still be resident on a lazy-allocating
+                    # tunneled device), and that must consume a retry and
+                    # shrink again, not abort the whole prewarm (the r5
+                    # 32-slot bench died exactly here).
+                    self.reset()
+                    self._stopped.clear()
                 self._verify_alloc()
                 return
             except Exception as exc:  # noqa: BLE001 — filtered below
@@ -579,11 +654,6 @@ class Engine:
                 # the rebuild allocates the replacement pool.
                 exc = None  # noqa: F841
                 self._n_pages = new_pages
-                # reset() disowns a possibly-wedged loop, fails the dummy
-                # stream, clears slot/page bookkeeping and rebuilds the
-                # device state at the NEW (self._n_pages) size.
-                self.reset()
-                self._stopped.clear()
 
     def _verify_alloc(self) -> None:
         """Serve one worst-case request for real — max-length prompt,

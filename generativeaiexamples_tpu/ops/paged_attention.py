@@ -363,17 +363,13 @@ def _paged_attention_decode_quant(q, pool_k, pool_v, pool_ks, pool_vs,
         denom = l * a + bta
         out_ref[0] = (out / denom).reshape(H, hd).astype(out_ref.dtype)
 
-        # Append: quantize the new row per kv head (kv_quant semantics —
-        # the stored bf16 scale is the one used for the divide).
-        def rowq(x):
-            amax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)  # (KV,1)
-            s = (jnp.maximum(amax, 1e-8) / 127.0).astype(jnp.bfloat16)
-            qr = jnp.clip(jnp.round(x / s.astype(jnp.float32)),
-                          -127.0, 127.0).astype(jnp.int8)
-            return qr, s[:, 0]                                  # (KV,hd),(KV,)
-
-        k_int, k_s = rowq(ck)
-        v_int, v_s = rowq(cv)
+        # Append: quantize the new row per kv head. The SAME function the
+        # engine's insert/gather paths use (ops/kv_quant.py) runs inside
+        # the kernel body — plain jnp, and single-sourcing it keeps the
+        # appended rows bit-identical to bucket-inserted rows.
+        from .kv_quant import quantize_rows
+        k_int, k_s = quantize_rows(ck)          # (KV, hd) int8, (KV,) bf16
+        v_int, v_s = quantize_rows(cv)
         off = off_ref[b]
         tile0 = (off // _TILE) * _TILE
         last = jnp.maximum(n_pages - 1, 0)

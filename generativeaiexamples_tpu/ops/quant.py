@@ -26,6 +26,8 @@ from __future__ import annotations
 
 from typing import Any, Union
 
+import os
+
 import jax
 import jax.numpy as jnp
 
@@ -121,6 +123,29 @@ def dequantize(w: QTensor, dtype=jnp.bfloat16) -> jax.Array:
     return (q * w["scale"][..., None, :]).astype(dtype)
 
 
+def _use_int4_kernel(w: QTensor) -> bool:
+    """Packed-int4 Pallas matmul gate: TPU backend, 2D weight, kernel-
+    supported geometry (ops/int4_matmul.py). The XLA path must unpack
+    the nibbles to a full int8 tensor inside the decode scan — 5x the
+    int4 HBM bytes per step (measured r5: 72 vs 504 tok/s on 7B) — so
+    the kernel is the difference between int4 being a capacity+speed win
+    and a capacity-only trade."""
+    if os.environ.get("GENAI_TPU_INT4_KERNEL", "1") == "0":
+        return False
+    q4 = w["q4"]
+    if q4.ndim != 2 or "gbias" in w:
+        return False
+    from .int4_matmul import supported
+    gs = 0
+    if is_grouped(w):
+        gs = (2 * q4.shape[0]) // w["gscale"].shape[-2]
+    try:
+        return (jax.default_backend() == "tpu"
+                and supported(2 * q4.shape[0], q4.shape[1], group_size=gs))
+    except Exception:  # noqa: BLE001 — no backend yet
+        return False
+
+
 def matmul(x: jax.Array, w: Union[jax.Array, QTensor]) -> jax.Array:
     """``x @ w`` where w may be raw or quantized.
 
@@ -132,9 +157,19 @@ def matmul(x: jax.Array, w: Union[jax.Array, QTensor]) -> jax.Array:
     a bf16 copy of the whole weight. The per-channel scale is applied
     after the matmul (mathematically identical, one multiply per output
     element instead of per weight).
+
+    int4 on TPU routes through the packed-nibble Pallas kernel
+    (ops/int4_matmul.py) so HBM sees only the int4 bytes.
     """
     if not is_quantized(w):
         return x @ w
+    if "q4" in w and _use_int4_kernel(w):
+        from .int4_matmul import int4_matmul
+        scale = w["gscale"] if is_grouped(w) else w["scale"]
+        # AWQ activation smoothing folds into the inputs; GPTQ's rank-1
+        # gbias term is not in the kernel (gated in _use_int4_kernel)
+        xin = x * w["pre_scale"] if "pre_scale" in w else x
+        return int4_matmul(xin.astype(x.dtype), w["q4"], scale)
     q = _int_weights(w)
     if is_grouped(w):
         return _grouped_matmul(x, q, w)
@@ -157,6 +192,12 @@ def matmul_f32(x: jax.Array, w: Union[jax.Array, QTensor]) -> jax.Array:
     compact dtypes with f32 MXU accumulation, which is numerically the
     same: bf16/int8 operand values carry no extra mantissa to lose.
     """
+    if is_quantized(w) and "q4" in w and _use_int4_kernel(w):
+        from .int4_matmul import int4_matmul
+        scale = w["gscale"] if is_grouped(w) else w["scale"]
+        xin = x * w["pre_scale"] if "pre_scale" in w else x
+        return int4_matmul(xin.astype(x.dtype), w["q4"], scale,
+                           out_dtype=jnp.float32)
     if is_grouped(w):
         return _grouped_matmul(x, _int_weights(w), w,
                                out_dtype=jnp.float32)
