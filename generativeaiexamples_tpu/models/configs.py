@@ -108,6 +108,16 @@ GPTNEXT_TINY = LlamaConfig(vocab_size=512, hidden_size=128,
 LLAMA_TINY = LlamaConfig(vocab_size=512, hidden_size=128, intermediate_size=352,
                          num_layers=2, num_heads=4, num_kv_heads=2, head_dim=32,
                          max_position_embeddings=512)
+# The golden-tiny geometry: real 32k-vocab tokenizer + TRAINED weights
+# (tools/make_golden_checkpoint.py trains it on the repo docs; the
+# committed checkpoint under tests/fixtures/golden_tiny/ is the CI gate
+# for real-vocab detokenization and quantization quality — the coverage
+# random-init weights structurally cannot give).
+GOLDEN_TINY = LlamaConfig(vocab_size=32000, hidden_size=64,
+                          intermediate_size=176, num_layers=2,
+                          num_heads=4, num_kv_heads=2, head_dim=16,
+                          max_position_embeddings=512,
+                          tie_word_embeddings=False)
 LLAMA_1B = LlamaConfig(vocab_size=32000, hidden_size=2048,
                        intermediate_size=5632, num_layers=22,
                        num_heads=32, num_kv_heads=4, head_dim=64)
@@ -126,6 +136,7 @@ MODEL_REGISTRY: dict[str, LlamaConfig] = {
     "nemotron-8b-chat": NEMOTRON_8B,
     "gptnext-tiny": GPTNEXT_TINY,
     "llama-tiny": LLAMA_TINY,
+    "golden-tiny": GOLDEN_TINY,
     "llama-1b": LLAMA_1B,
 }
 

@@ -30,6 +30,8 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+import contextlib  # noqa: E402
+
 import pytest  # noqa: E402
 
 
@@ -45,3 +47,38 @@ def cpu_devices():
 def repo_root():
     import pathlib
     return pathlib.Path(__file__).resolve().parent.parent
+
+
+@contextlib.contextmanager
+def serve_app(app, timeout: float = 30.0):
+    """Run an aiohttp app on an ephemeral port in a background thread;
+    yields the base URL. Shared by every test that drives a live HTTP
+    surface (score endpoint, real-weights gate, ...)."""
+    import asyncio
+    import threading
+
+    from aiohttp import web
+
+    loop = asyncio.new_event_loop()
+    box: dict = {}
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+
+        async def boot():
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            box["port"] = runner.addresses[0][1]
+        loop.run_until_complete(boot())
+        started.set()
+        loop.run_forever()
+
+    threading.Thread(target=run, daemon=True).start()
+    assert started.wait(timeout), "HTTP server failed to boot in time"
+    try:
+        yield f"http://127.0.0.1:{box['port']}"
+    finally:
+        loop.call_soon_threadsafe(loop.stop)

@@ -50,6 +50,33 @@ def test_chunked_score_matches_full_forward(params):
                                rtol=2e-4, atol=2e-4)
 
 
+def test_chunked_cache_lengths_bucket_to_powers_of_two(params):
+    """Distinct document lengths must SHARE compiled per-chunk steps:
+    the KV cache is sized to a power-of-two bucket, not the document's
+    own padded length (r4 advisor: per-length retraces took seconds
+    each while holding the server's score gate). Numerics stay exact —
+    the padded tail is masked."""
+    shapes = []
+    orig = llama._score_chunk_step(CFG)
+
+    def spy(p, cache, tok_c, pos_c):
+        shapes.append(cache["k"].shape[2])
+        return orig(p, cache, tok_c, pos_c)
+
+    import unittest.mock as mock
+    with mock.patch.object(llama, "_score_chunk_step",
+                           side_effect=lambda cfg: spy):
+        for S in (130, 190, 250):   # S_pad 192/192/256 at chunk 64
+            tokens = jax.random.randint(jax.random.key(S), (1, S), 0, 256,
+                                        jnp.int32)
+            got = llama.score(params, CFG, tokens, chunk=64)
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(_full_nll(params, tokens)),
+                rtol=2e-4, atol=2e-4)
+    # all three lengths land on ONE cache bucket (256): one compiled step
+    assert set(shapes) == {256}
+
+
 def test_short_sequence_takes_single_pass(params):
     tokens = jax.random.randint(jax.random.key(2), (1, 32), 0, 256,
                                 jnp.int32)
