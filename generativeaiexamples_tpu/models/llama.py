@@ -398,6 +398,124 @@ def apply_decode_paged(params: Params, cfg: LlamaConfig, tokens: jax.Array,
     return unembed(params, cfg, h), cache
 
 
+def _paged_prefix_attention(q, k_self, v_self, kc, vc, ksc, vsc,
+                            block_table, start, kv_valid_len, page: int,
+                            cfg: LlamaConfig, block_pages: int = 8):
+    """Chunk queries attend [pooled prefix] + [their own chunk], with the
+    prefix STREAMED from the pool in ``block_pages``-page blocks under an
+    online softmax.
+
+    The former implementation gathered the whole window up front —
+    (1, P*page, KV, hd) per layer, ~4 GB per tensor at 16k tokens on 7B —
+    which capped chunked long-prompt serving far below the pool's own
+    capacity. Block streaming bounds the transient to one block's K/V
+    plus one (KV, G, C, block) score tile, independent of prefix length.
+
+    q:            (1, C, H, hd) post-rope queries (C = chunk length)
+    k/v_self:     (1, C, KV, hd) this chunk's post-rope K/V (NOT yet in
+                  the pool — the pool's rows for these positions are
+                  stale, so the self part computes in-register)
+    kc/vc:        (N, KV, page, hd) one layer's pool (int8 when ksc/vsc
+                  per-row scale layers are given)
+    block_table:  (1, P) logical→physical window
+    start:        () int32 — absolute position of the chunk's first row
+                  (page-aligned); pool rows with logical position >=
+                  start are masked (stale/future)
+    kv_valid_len: (1,) int32 — start + valid tokens in this chunk
+    Returns (1, C, H, hd) in q.dtype.
+    """
+    B, C, H, hd = q.shape
+    KV = cfg.num_kv_heads
+    G = H // KV
+    scale = 1.0 / (hd ** 0.5)
+    P = block_table.shape[1]
+    nb = -(-P // block_pages)
+    tbl = jnp.pad(block_table[0], (0, nb * block_pages - P))
+    cd = q.dtype
+    # operands stay in storage dtype into the MXU with f32 accumulation
+    # (casting whole K/V blocks to f32 up front would double the
+    # prefix stream's HBM bytes — the anti-pattern ops/attention.py's
+    # chunked path documents avoiding); softmax state is f32.
+    qf = q[0].reshape(C, KV, G, hd)
+    tblk = block_pages * page
+    rel = jnp.arange(C, dtype=jnp.int32)
+
+    def online(carry, s, mask, vb):
+        """One online-softmax update. s: (KV, G, C, T) f32 scores,
+        mask (C, T) or (T,); explicit zeroing of masked probabilities —
+        relying on exp(-1e30 - m) underflow alone breaks the moment a
+        stale pool row is non-finite (NaN * 0 = NaN)."""
+        m, l, acc = carry
+        mb = jnp.broadcast_to(mask, s.shape[-2:])[None, None]
+        s = jnp.where(mb, s, -1e30)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.where(mb, jnp.exp(s - m_new[..., None]), 0.0)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_new = (acc * alpha[..., None]
+                   + jnp.einsum("kgct,tkh->kgch", p.astype(cd), vb,
+                                preferred_element_type=jnp.float32))
+        return m_new, l_new, acc_new
+
+    def dequant_block(pool, scales, pages):
+        g = pool[pages]                         # (bp, KV, page, hd)
+        if scales is not None:
+            from ..ops.kv_quant import dequantize_rows
+            g = dequantize_rows(g, scales[pages], cd)
+        return g.swapaxes(1, 2).reshape(tblk, KV, hd).astype(cd)
+
+    def block(carry, bi):
+        def live(carry):
+            pages = jax.lax.dynamic_slice(tbl, (bi * block_pages,),
+                                          (block_pages,))
+            kb = dequant_block(kc, ksc, pages)
+            vb = dequant_block(vc, vsc, pages)
+            t = bi * tblk + jnp.arange(tblk, dtype=jnp.int32)
+            s = jnp.einsum("ckgh,tkh->kgct", qf, kb,
+                           preferred_element_type=jnp.float32) * scale
+            # prefix rows only: pool rows at/past `start` are stale
+            # (this chunk's own rows land post-scan) — and every prefix
+            # row is causally visible to every chunk query (t < start)
+            return online(carry, s, t < start, vb)
+        # blocks wholly past the prefix would be gathered then fully
+        # masked — skip their HBM reads and matmuls at runtime
+        return jax.lax.cond(bi * tblk < start, live,
+                            lambda c: c, carry), None
+
+    m0 = jnp.full((KV, G, C), -1e30, jnp.float32)
+    l0 = jnp.zeros((KV, G, C), jnp.float32)
+    acc0 = jnp.zeros((KV, G, C, hd), jnp.float32)
+    (m, l, acc), _ = jax.lax.scan(
+        block, (m0, l0, acc0), jnp.arange(nb, dtype=jnp.int32))
+
+    # the chunk itself, ALSO in key blocks — a dense (KV, G, C, C) f32
+    # score tensor at C=2048 on 7B is 512 MB/layer, the transient the
+    # chunked-attention machinery exists to avoid
+    sb = min(C, 512)
+    while C % sb:
+        sb //= 2
+    ks, vs = k_self[0], v_self[0]               # (C, KV, hd)
+
+    def self_block(carry, si):
+        kb = jax.lax.dynamic_slice(ks, (si * sb, 0, 0), (sb, KV, hd))
+        vb = jax.lax.dynamic_slice(vs, (si * sb, 0, 0), (sb, KV, hd))
+        tloc = si * sb + jnp.arange(sb, dtype=jnp.int32)
+        s = jnp.einsum("ckgh,tkh->kgct", qf, kb,
+                       preferred_element_type=jnp.float32) * scale
+        ok = (tloc[None, :] <= rel[:, None]) \
+            & ((start + tloc) < kv_valid_len[0])[None, :]
+        return online(carry, s, ok, vb), None
+
+    (m, l, acc), _ = jax.lax.scan(
+        self_block, (m, l, acc), jnp.arange(C // sb, dtype=jnp.int32))
+    # valid queries attend at least themselves (l > 0); PADDED rows past
+    # kv_valid_len attend nothing — floor the denominator so they yield
+    # zeros, not NaNs that would trip debug tooling downstream
+    out = acc / jnp.maximum(l[..., None], 1e-30)
+    # (KV, G, C, hd) -> (1, C, H, hd)
+    return out.transpose(2, 0, 1, 3).reshape(1, C, H, hd).astype(q.dtype)
+
+
 def apply_prefill_paged(params: Params, cfg: LlamaConfig, tokens: jax.Array,
                         positions: jax.Array, kv_cache: KVCache,
                         block_table: jax.Array, kv_valid_len: jax.Array,
@@ -452,18 +570,15 @@ def apply_prefill_paged(params: Params, cfg: LlamaConfig, tokens: jax.Array,
             ksc = vsc = None
 
         def attend(q, k, v):
-            kg = _gathered_window(kc, ksc, block_table, B, P, page, cfg,
-                                  h.dtype)
-            vg = _gathered_window(vc, vsc, block_table, B, P, page, cfg,
-                                  h.dtype)
-            # this chunk joins the window in-register; its pool write
-            # happens in the one post-scan scatter
-            kg = jax.lax.dynamic_update_slice(
-                kg, k.astype(kg.dtype), (0, start, 0, 0))
-            vg = jax.lax.dynamic_update_slice(
-                vg, v.astype(vg.dtype), (0, start, 0, 0))
-            return gqa_attention(q, kg, vg, positions, kv_valid_len), \
-                (k[0], v[0])
+            # prefix streamed from the pool block-by-block (online
+            # softmax) + the chunk's own K/V in-register; the pool write
+            # happens in the one post-scan scatter. Never materializes
+            # the full gathered window — prefix length does not bound
+            # this path's memory.
+            attn = _paged_prefix_attention(
+                q, k, v, kc, vc, ksc, vsc, block_table, start,
+                kv_valid_len, page, cfg)
+            return attn, (k[0], v[0])
 
         return decoder_layer(h, lp, cfg, positions, inv_freq, kv_valid_len,
                              attend=attend)
