@@ -180,6 +180,64 @@ def test_kv_quant_roundtrip_error_bound():
     assert rel < 0.02, rel
 
 
+@pytest.mark.parametrize("quant", [False, True])
+def test_paged_prefix_attention_multiblock_matches_gather(quant):
+    """The chunked-prefill streamed-prefix attention vs the gather
+    formulation it replaced, with a prefix spanning SEVERAL stream
+    blocks (block_pages=2 over a 7-page prefix) — the cross-block
+    online-softmax rescale and nonzero dynamic-slice offsets are
+    exactly the paths single-block engine tests never reach."""
+    from generativeaiexamples_tpu.models.configs import LlamaConfig
+    from generativeaiexamples_tpu.models.llama import \
+        _paged_prefix_attention
+    from generativeaiexamples_tpu.ops.attention import gqa_attention
+    from generativeaiexamples_tpu.ops.kv_quant import (dequantize_rows,
+                                                       quantize_rows)
+
+    cfg = LlamaConfig(vocab_size=64, hidden_size=64, intermediate_size=64,
+                      num_layers=1, num_heads=8, num_kv_heads=4,
+                      head_dim=hd, max_position_embeddings=512)
+    ks = jax.random.split(jax.random.key(9), 6)
+    C = 32                                  # chunk (2 pages of 16)
+    start = 7 * page                        # prefix: 7 pages -> 4 blocks
+    Pw = 10                                 # window incl. chunk + slack
+    valid = jnp.asarray([start + C - 5], jnp.int32)   # ragged tail
+    pool_k = jax.random.normal(ks[0], (1, N, KV, page, hd), jnp.float32)
+    pool_v = jax.random.normal(ks[1], (1, N, KV, page, hd), jnp.float32)
+    table = jnp.asarray([[1, 3, 5, 7, 2, 4, 6, 8, 9, 10]], jnp.int32)
+    q = jax.random.normal(ks[2], (1, C, 8, hd), jnp.float32)
+    k_self = jax.random.normal(ks[3], (1, C, KV, hd), jnp.float32)
+    v_self = jax.random.normal(ks[4], (1, C, KV, hd), jnp.float32)
+
+    kc, vc, ksc, vsc = pool_k[0], pool_v[0], None, None
+    if quant:
+        kq, kscale = quantize_rows(pool_k[0])
+        vq, vscale = quantize_rows(pool_v[0])
+        kc = dequantize_rows(kq, kscale, jnp.float32)
+        vc = dequantize_rows(vq, vscale, jnp.float32)
+
+    # oracle: the old formulation — gather the whole window, insert the
+    # chunk in-register, run the house gqa_attention
+    kg = kc[table].swapaxes(2, 3).reshape(1, Pw * page, KV, hd)
+    vg = vc[table].swapaxes(2, 3).reshape(1, Pw * page, KV, hd)
+    kg = jax.lax.dynamic_update_slice(kg, k_self, (0, start, 0, 0))
+    vg = jax.lax.dynamic_update_slice(vg, v_self, (0, start, 0, 0))
+    positions = (start + jnp.arange(C, dtype=jnp.int32))[None, :]
+    want = gqa_attention(q, kg, vg, positions, valid)
+
+    got = _paged_prefix_attention(
+        q, k_self, v_self,
+        kq if quant else kc, vq if quant else vc,
+        kscale if quant else None, vscale if quant else None,
+        table, jnp.asarray(start, jnp.int32), valid, page, cfg,
+        block_pages=2)
+    # rows past kv_valid_len are don't-care (engine discards them)
+    n_ok = C - 5
+    np.testing.assert_allclose(np.asarray(got)[0, :n_ok],
+                               np.asarray(want)[0, :n_ok],
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_kernel_supported_gate():
     assert kernel_supported(128, 32, 32, 128)
     assert not kernel_supported(128, 32, 32, 64)   # hd not lane-width
