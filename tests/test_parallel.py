@@ -213,6 +213,36 @@ def test_engine_tp_mesh_kernel_path_parity(cpu_devices, monkeypatch):
     assert not eng_pp._use_kernel
 
 
+def test_engine_tp_mesh_chunked_long_prompt(cpu_devices):
+    """Chunked long-prompt admission under a tp mesh: the block-streamed
+    prefix attention runs with the pool's KV heads GSPMD-sharded over
+    tp, and greedy output matches the meshless chunked engine exactly."""
+    from generativeaiexamples_tpu.engine import (Engine, EngineConfig,
+                                                 SamplingParams)
+    from generativeaiexamples_tpu.models.tokenizer import ByteTokenizer
+
+    params = llama.init_params(CFG, jax.random.key(5), dtype=jnp.float32)
+    ecfg = EngineConfig(max_slots=2, max_input_length=128,
+                        max_output_length=16, prefill_buckets=(32,),
+                        page_size=16, dtype="float32",
+                        kv_pool_tokens=None, steps_per_round=4,
+                        max_prefill_bucket=32)
+    tok = ByteTokenizer()
+    sp = SamplingParams(max_tokens=8, top_k=1, ignore_eos=True)
+    prompt = [(i * 11) % 250 + 3 for i in range(100)]   # 100 > bucket 32
+
+    with Engine(params, CFG, tok, ecfg) as ref_eng:
+        ref = ref_eng.submit(prompt, sp)
+        ref.text()
+
+    mesh = make_mesh(MeshPlan(tp=2), jax.devices()[:2])
+    with Engine(params, CFG, tok, ecfg, mesh=mesh) as eng:
+        got = eng.submit(prompt, sp)
+        got.text()
+    assert got.token_ids == ref.token_ids, (got.token_ids, ref.token_ids)
+    assert got.finish_reason == "length"
+
+
 def test_engine_sp_mesh_serving_prefill(cpu_devices):
     """SERVING under a dp×sp mesh: admission prefill runs the
     ring-attention path (activations sequence-sharded — the long-prompt
