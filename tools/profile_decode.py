@@ -44,7 +44,9 @@ def main():
           f"live={live_pages} steps={K}")
 
     n_pages = B * W + 1
-    cache = llama.init_paged_kv_cache(cfg, n_pages, page, dt)
+    kv_quant = os.environ.get("PROF_KV_QUANT", "") == "int8"
+    cache = llama.init_paged_kv_cache(cfg, n_pages, page, dt,
+                                      quantized=kv_quant)
     table = jnp.asarray(
         np.arange(1, 1 + B * W, dtype=np.int32).reshape(B, W))
     pos0 = jnp.full((B,), live_pages * page - K - 2, jnp.int32)
@@ -93,8 +95,10 @@ def main():
               f"{B/ms*1e3:.0f} tok/s)")
         return ms
 
+    # bytes per cached token: int8 rows + bf16 scales under PROF_KV_QUANT
+    row_bytes = (cfg.head_dim + 2) if kv_quant else cfg.head_dim * 2
     kv_live = (live_pages * page * cfg.num_layers * cfg.num_kv_heads
-               * cfg.head_dim * 2 * 2 * B)
+               * row_bytes * 2 * B)
     full = run("full round   ", make_round(), kv_live)
     nou = run("no unembed   ", make_round("no_unembed"), kv_live)
     w1 = run("window=1     ", make_round("window1"),
