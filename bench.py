@@ -12,6 +12,9 @@ numbers of its own — BASELINE.md):
 3. E2E chatbot: TTFT through the chain server over HTTP (retrieve -> embed
    query on-device -> prompt template -> engine prefill -> first SSE chunk),
    i.e. the reference's POST /generate hot path (common/server.py:121-142).
+4. Multi-turn chat: warm-turn (shared-prefix KV cache hit) engine TTFT vs
+   the cold start, over a conversation with a shared system prompt and
+   growing history (run_chat_bench).
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "ms", "vs_baseline": N, ...}
@@ -22,7 +25,9 @@ Env knobs: BENCH_MODEL (default llama-2-7b-chat), BENCH_QUANT (int8 default
 30 GB for 7B fp16 and ships int4-AWQ for small-memory parts,
 docs/rag/support_matrix.md:4-12 — none|int8|int4 to override),
 BENCH_PROMPT_LEN, BENCH_OUTPUT_LEN, BENCH_REQUESTS, BENCH_SLOTS,
-BENCH_STEPS_PER_ROUND, BENCH_DISPATCH_DEPTH, BENCH_SKIP_E2E;
+BENCH_STEPS_PER_ROUND, BENCH_DISPATCH_DEPTH, BENCH_SKIP_E2E,
+BENCH_SKIP_CHAT, BENCH_CHAT_TURNS, BENCH_CHAT_SYSTEM (multi-turn chat
+scenario: warm shared-prefix TTFT vs cold, engine prefix cache);
 BENCH_MODEL_PATH points at a real checkpoint dir (weights + tokenizer
 loaded via the import pipeline instead of random init).
 
@@ -205,11 +210,17 @@ def run_engine_bench(engine, prompt_len: int, out_len: int, n_requests: int,
             max_tokens=s + 1, top_k=1, ignore_eos=True)).text()
 
     # TTFT: sequential requests against an idle engine (the reference's
-    # single-user chat scenario).
+    # single-user chat scenario). Each request's LEADING tokens are
+    # unique (two varied tokens -> 15625 distinct first blocks, residues
+    # 4..128 disjoint from the decode-window loop's 130..254 below) so
+    # the prefix cache never matches — this metric stays the COLD-start
+    # TTFT it always was (r05-comparable); warm-turn TTFT is measured by
+    # the chat scenario (run_chat_bench) next to it.
     ttfts = []
-    for _ in range(n_requests):
-        stream = engine.submit(prompt_ids, SamplingParams(
-            max_tokens=2, top_k=1, ignore_eos=True))
+    for i in range(n_requests):
+        stream = engine.submit(
+            [4 + (i % 125), 4 + ((i // 125) % 125)] + prompt_ids[2:],
+            SamplingParams(max_tokens=2, top_k=1, ignore_eos=True))
         stream.text()
         ttfts.append(stream.ttft_ms)
     ttfts.sort()
@@ -222,7 +233,13 @@ def run_engine_bench(engine, prompt_len: int, out_len: int, n_requests: int,
     # would otherwise pollute the number (r3 under-reported ~2x).
     long_sp = SamplingParams(max_tokens=out_len * 2, top_k=1,
                              ignore_eos=True)
-    streams = [engine.submit(prompt_ids, long_sp) for _ in range(slots)]
+    # distinct first tokens, in a residue range (130..254) disjoint from
+    # the TTFT loop's (4..128): every slot's prefill stays cold however
+    # large BENCH_REQUESTS/BENCH_SLOTS get, so the steady-decode window
+    # measures the same work as previous rounds
+    streams = [engine.submit(
+        [130 + (j % 125), 4 + ((j // 125) % 125)] + prompt_ids[2:],
+        long_sp) for j in range(slots)]
     deadline = time.monotonic() + 300
     while any(s.first_token_time is None for s in streams) \
             and time.monotonic() < deadline:
@@ -246,6 +263,81 @@ def run_engine_bench(engine, prompt_len: int, out_len: int, n_requests: int,
     else:  # degenerate window: fall back to wall-clock over everything
         tput = total / max(time.monotonic() - t0, 1e-6)
     return p50, p99, tput, time.monotonic() - t0
+
+
+def run_chat_bench(engine, n_turns: int = 6, system_len: int = 512,
+                   user_len: int = 64, reply_len: int = 32,
+                   warmup: bool = True):
+    """Multi-turn chat scenario: the prefix-cache workload.
+
+    Every turn's prompt is the shared system prompt + the FULL prior
+    conversation + a new user message — exactly the traffic shape where
+    recomputing prefill is pure waste. Turn 1 is the cold start (empty
+    cache for this conversation); turns 2+ hit the cached prefix and
+    prefill only the new suffix. Reports warm-turn TTFT next to the
+    cold number plus the engine's prefix-cache counters for the run
+    (``prefix_cache_hit_tokens`` asserts prefill actually started at
+    the first uncached token rather than the TTFT delta being noise).
+
+    ``warmup`` runs a throwaway conversation with DIFFERENT content
+    first: same shapes, so every suffix-chunk program is compiled
+    before measurement, but different block hashes, so the measured
+    turn 1 stays genuinely cold.
+    """
+    import statistics
+
+    from generativeaiexamples_tpu.engine import SamplingParams
+
+    vocab = getattr(engine.model_cfg, "vocab_size", 32000)
+    span = min(vocab - 4, 250)
+
+    def ids(seed: int, n: int) -> list:
+        return [(seed * 131 + 7 * i) % span + 4 for i in range(n)]
+
+    sp = SamplingParams(max_tokens=reply_len, top_k=1, ignore_eos=True)
+    max_prompt = engine.cfg.max_input_length
+
+    def run_convo(tag: int):
+        history = ids(tag, system_len)
+        cold, warm = None, []
+        for t in range(n_turns):
+            prompt = history + ids(tag * 1009 + t + 1, user_len)
+            if len(prompt) >= max_prompt:
+                break
+            stream = engine.submit(prompt, sp)
+            stream.text()
+            if t == 0:
+                cold = stream.ttft_ms
+            else:
+                warm.append(stream.ttft_ms)
+            history = prompt + stream.token_ids
+        return cold, warm
+
+    engine.start()
+    if warmup:
+        run_convo(tag=7919)
+    before = engine.stats
+    cold, warm = run_convo(tag=1)
+    after = engine.stats
+    hit = int(after.get("prefix_cache_hit_tokens", 0)
+              - before.get("prefix_cache_hit_tokens", 0))
+    lookup = int(after.get("prefix_cache_lookup_tokens", 0)
+                 - before.get("prefix_cache_lookup_tokens", 0))
+    return {
+        "turns": 1 + len(warm),
+        "system_prompt_tokens": system_len,
+        "cold_ttft_ms": round(cold, 2) if cold else None,
+        "warm_p50_ttft_ms": (round(statistics.median(warm), 2)
+                             if warm else None),
+        "warm_min_ttft_ms": round(min(warm), 2) if warm else None,
+        "warm_ttfts_ms": [round(w, 2) for w in warm],
+        "prefix_cache_hit_tokens": hit,
+        "prefix_cache_hit_rate": (round(hit / lookup, 3) if lookup
+                                  else 0.0),
+        "prefix_cache_evicted_pages": int(
+            after.get("prefix_cache_evicted_pages", 0)
+            - before.get("prefix_cache_evicted_pages", 0)),
+    }
 
 
 def hbm_utilization(engine, model_cfg, tput: float, slots: int,
@@ -348,15 +440,25 @@ def run_e2e_bench(engine, embedder, n_requests: int):
     all_stages: list = []
     set_stage_collector(lambda name, dt: stages.setdefault(name, dt))
 
-    def one_ttft() -> float:
+    def one_ttft(seq: int) -> float:
         # num_tokens bounds the overestimate: with random weights the
         # detokenizer often withholds everything until the final flush
         # (no valid UTF-8), so first-byte time degenerates to completion
         # time. Real checkpoints stream normally.
+        #
+        # The question varies per request: on the host (non-fused) RAG
+        # path every request submits the templated prompt through
+        # engine.submit, and an identical question would make request
+        # 2+ a full-cover prefix-cache hit — the headline e2e number
+        # must stay the COLD TTFT it was in r05 (warm TTFT is the chat
+        # scenario's job). The shared system/context prefix still
+        # matching is the production-realistic part and is reported by
+        # the engine's hit counters, not hidden.
         stages.clear()
         t0 = time.monotonic()
         with requests.post(url, json={
-                "question": "What does the MXU do and how big is it?",
+                "question": f"(case {seq}) What does the MXU do and "
+                            f"how big is it?",
                 "use_knowledge_base": True, "num_tokens": 16},
                 stream=True, timeout=300) as resp:
             resp.raise_for_status()
@@ -387,9 +489,9 @@ def run_e2e_bench(engine, embedder, n_requests: int):
         all_stages.append(dict(stages))
         return dt
 
-    one_ttft()  # warmup: compiles the e2e prompt geometry
+    one_ttft(seq=0)  # warmup: compiles the e2e prompt geometry
     all_stages.clear()
-    raw = [one_ttft() for _ in range(n_requests)]
+    raw = [one_ttft(seq=1 + i) for i in range(n_requests)]
     set_stage_collector(None)
     loop.call_soon_threadsafe(loop.stop)
     ttfts = sorted(raw)
@@ -517,6 +619,18 @@ def main() -> None:
     try:
         achieved_bw, bw_util, bw_steady = hbm_utilization(
             engine, model_cfg, tput, slots, prompt_len, out_len)
+        # Multi-turn chat: warm-turn (shared-prefix) TTFT next to the
+        # cold-start number above. Degrades, never aborts the bench.
+        chat = None
+        if not os.environ.get("BENCH_SKIP_CHAT"):
+            try:
+                chat = run_chat_bench(
+                    engine,
+                    n_turns=int(os.environ.get("BENCH_CHAT_TURNS", "6")),
+                    system_len=int(os.environ.get(
+                        "BENCH_CHAT_SYSTEM", "512")))
+            except Exception as exc:  # noqa: BLE001
+                sys.stderr.write(f"bench: chat scenario failed: {exc}\n")
         e2e_p50, e2e_dist, e2e_breakdown = None, None, None
         if not skip_e2e:
             try:
@@ -546,6 +660,8 @@ def main() -> None:
         # False = slots exceeded the pool's page capacity; tput and the
         # roofline number caught re-admission churn and are unreliable
         "decode_window_steady": bw_steady,
+        # Multi-turn scenario: cold vs warm (shared-prefix) engine TTFT
+        "chat": chat,
         "e2e_chat_ttft_ms": round(e2e_p50, 2) if e2e_p50 else None,
         "e2e_chat_p99_ttft_ms": e2e_dist["p99"] if e2e_dist else None,
         "e2e_ttft_dist_ms": e2e_dist,

@@ -148,6 +148,16 @@ def create_app(example: BaseExample,
         return web.json_response(result)
 
     async def metrics_endpoint(request: web.Request) -> web.Response:
+        # Scrape-time engine snapshot: when the example serves an
+        # in-process engine (EngineLLM), surface its counters — decode
+        # steps, prefills, prefix-cache hit tokens/rate/evictions — as
+        # engine_* gauges next to the chain-level request metrics.
+        engine = getattr(getattr(example, "llm", None), "engine", None)
+        if engine is not None:
+            try:
+                obs_metrics.record_engine_stats(engine.stats)
+            except Exception:  # noqa: BLE001 — metrics must never 500
+                logger.debug("engine stats unavailable", exc_info=True)
         return web.Response(text=obs_metrics.REGISTRY.render_prometheus(),
                             content_type="text/plain")
 

@@ -125,11 +125,20 @@ class ApiServerKube(KubeInterface):
                                       context=self._ctx)
         except urlerror.HTTPError as exc:
             detail = exc.read().decode(errors="replace")[:500]
-            if exc.code == 404 and method in ("GET", "DELETE"):
+            if exc.code == 404 and method in ("GET", "DELETE") \
+                    and not stream:
                 # absent object: a read/delete miss, never a write —
                 # swallowing a 404 on POST/PUT/PATCH would report a
-                # deploy that created nothing as success
+                # deploy that created nothing as success. Stream (watch)
+                # requests are excluded: the caller iterates the return
+                # value, so a None here surfaces later as a baffling
+                # "'NoneType' is not iterable" busy loop instead of the
+                # real cause (the CRD is not installed) — raise it.
                 return None
+            if exc.code == 404 and stream:
+                raise RuntimeError(
+                    f"apiserver watch {path} -> 404: resource collection "
+                    f"missing (CRD not installed?): {detail}") from exc
             if exc.code == 409:
                 raise ConflictError(detail) from exc
             if exc.code in (400, 403, 422):
@@ -228,11 +237,12 @@ class ApiServerKube(KubeInterface):
         path = resource_path(api_version, kind, "x")
         head, _, plural = path.rpartition("/")
         head = head.rsplit("/namespaces/", 1)[0]
-        resp = self._request(
-            "GET", f"{head}/{plural}", stream=True,
-            query={"watch": "1", "timeoutSeconds": str(timeout_seconds)},
-            timeout=timeout_seconds + 10)
+        resp = None
         try:
+            resp = self._request(
+                "GET", f"{head}/{plural}", stream=True,
+                query={"watch": "1", "timeoutSeconds": str(timeout_seconds)},
+                timeout=timeout_seconds + 10)
             for raw in resp:
                 line = raw.decode(errors="replace").strip()
                 if not line:
@@ -242,4 +252,7 @@ class ApiServerKube(KubeInterface):
                 except json.JSONDecodeError:
                     continue  # torn line at window close
         finally:
-            resp.close()
+            # guard: _request raising leaves resp unset — an unguarded
+            # close() would mask the real error with an AttributeError
+            if resp is not None:
+                resp.close()

@@ -9,5 +9,6 @@ host-side scheduler thread.
 
 from .sampling_params import SamplingParams
 from .engine import Engine, EngineConfig
+from .prefix_cache import PrefixCache
 
-__all__ = ["SamplingParams", "Engine", "EngineConfig"]
+__all__ = ["SamplingParams", "Engine", "EngineConfig", "PrefixCache"]

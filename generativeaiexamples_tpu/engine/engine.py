@@ -54,6 +54,7 @@ from ..parallel.sharding import (llama_param_specs, paged_kv_cache_spec,
                                  shard_params)
 from ..utils.errors import ConfigError, EngineError, SchedulerFullError
 from .detokenizer import IncrementalDetokenizer, StopChecker
+from .prefix_cache import PrefixCache, hash_blocks, usable_prefix_tokens
 from .sampling_params import SamplingParams
 
 
@@ -98,6 +99,17 @@ class EngineConfig:
     # fixed HBM (the reference's batch-128 capacity rides the same
     # TRT-LLM lever; reference: config.pbtxt.j2:29).
     kv_quant: str = ""
+    # Shared-prefix KV reuse (engine/prefix_cache.py): prompts are hashed
+    # in page-sized blocks and admission maps the longest cached prefix
+    # into the slot's page table read-only, so prefill starts at the
+    # first uncached token — the repeat-turn/chat TTFT lever (vLLM
+    # prefix caching / SGLang RadixAttention, adapted to this pool).
+    # Retired requests' prompt pages stay resident at refcount 0 and are
+    # reclaimed LRU under pool pressure; the pool remains the only
+    # capacity budget. NOTE under kv_quant the reused prefix is read
+    # back dequantized, so a warm request tracks (not bit-matches) the
+    # cold trajectory — same caveat as chunked long-prompt admission.
+    prefix_cache: bool = True
 
     def __post_init__(self) -> None:
         # Geometry validation lives on the config, not the engine — a bad
@@ -226,6 +238,13 @@ class _Request:
     extent: int = 0           # prompt + eff_max (cache positions reserved)
     slot: int = -1
     pages: list[int] = field(default_factory=list)
+    # Prefix-cache bookkeeping: block hashes this request holds a ref on
+    # (matched prefix + blocks it registered), and which of req.pages are
+    # cache property (retire must NOT return those to the free list —
+    # they stay resident, warm for the next shared-prefix request).
+    cache_refs: list = field(default_factory=list)
+    cache_pages: set = field(default_factory=set)
+    block_hashes: Optional[list] = None  # memoized across _admit retries
     proj_pos: int = 0         # host upper bound on the device-side pos
     generated: int = 0
     greedy: bool = False      # top_k==1 / temp<=0: argmax fast path
@@ -318,6 +337,10 @@ class Engine:
         # the allocator hands out 1..n_pages-1.
         self._n_pages = 1 + self._resolve_pool_pages()
         self._free_pages = list(range(1, self._n_pages))
+        # Shared-prefix page reuse over the pool above. Mutated only on
+        # the serve-loop thread; reset() swaps in a fresh instance.
+        self._prefix_cache = (PrefixCache(page) if cfg.prefix_cache
+                              else None)
         self._state = self._init_device_state()
         self._base_key = jax.random.key(cfg.seed)
         self._step_counter = itertools.count()
@@ -558,6 +581,10 @@ class Engine:
             + (256 << 20)
 
     def _resolve_pool_pages(self) -> int:
+        # The resolved pool is the ONLY capacity budget: the prefix
+        # cache's warm (refcount-0) pages live inside it and are evicted
+        # back to the free list under admission pressure, so no extra
+        # headroom is reserved for caching (engine/prefix_cache.py).
         cfg = self.cfg
         full = cfg.max_slots * self._pmax
         spec = cfg.kv_pool_tokens
@@ -701,9 +728,17 @@ class Engine:
             self._stats["requests"] -= 1
 
     @property
-    def stats(self) -> dict[str, int]:
+    def stats(self) -> dict[str, float]:
         with self._stats_lock:
-            return dict(self._stats)
+            out = dict(self._stats)
+        cache = self._prefix_cache
+        if cache is not None:
+            # Cache counters are written only on the serve-loop thread;
+            # reading them here without its lock can tear between fields
+            # by at most one in-flight admission — fine for metrics.
+            out.update(cache.stats.snapshot())
+            out["prefix_cache_pages"] = cache.cached_pages
+        return out
 
     def _bump(self, key: str, n: int = 1) -> None:
         with self._stats_lock:
@@ -947,30 +982,41 @@ class Engine:
 
     # --------------------------------------------- long-prompt admission
 
-    def _chunk_seen(self, state, tokens, start, valid, slot, first: bool):
+    def _chunk_seen(self, state, tokens, start, valid, slot, mode: str,
+                    seen0=None):
         """Accumulate the slot's seen-token mask chunk by chunk (the
         repetition-penalty state the one-shot prefill computes in one
-        go). ``first`` REPLACES the previous occupant's stale mask."""
+        go). ``mode``: "replace" (chunk 0 of a cold chunked admission —
+        drop the previous occupant's stale mask), "accum" (OR into the
+        slot's mask), or "seed" (chunk 0 of a prefix-cache hit: OR into
+        ``seen0``, the host-built mask over the cached prefix tokens the
+        chunks never revisit)."""
         C = tokens.shape[1]
         in_chunk = jnp.clip(valid - start, 0, C)
         chunk_seen = seen_mask(tokens, in_chunk[None],
                                self.model_cfg.vocab_size)[0]
-        if not first:
+        if mode == "accum":
             chunk_seen = state["seen"][slot] | chunk_seen
+        elif mode == "seed":
+            chunk_seen = seen0 | chunk_seen
         return state["seen"].at[slot].set(chunk_seen)
 
-    def _chunk_extend_fn(self, window: int, first: bool):
-        """Jitted ONE-CHUNK paged prefill for prompts longer than every
-        bucket: the chunk's KV lands in the slot's pool pages and its
-        attention reads the whole prefix back from the pool
-        (models/llama.py apply_prefill_paged). Non-final chunks skip the
-        vocab projection entirely."""
-        key = ("extend", window, first)
+    def _chunk_extend_fn(self, window: int, mode: str):
+        """Jitted ONE-CHUNK paged prefill: the chunk's KV lands in the
+        slot's pool pages and its attention reads the whole prefix back
+        from the pool (models/llama.py apply_prefill_paged) — used both
+        for longer-than-any-bucket prompts and for prefix-cache hits,
+        whose first chunk starts at the first uncached token. Non-final
+        chunks skip the vocab projection entirely. ``mode`` is the seen
+        handling (_chunk_seen); "seed" variants take the prefix mask as
+        an extra arg so the TTFT path stays a single dispatch per chunk."""
+        key = ("extend", window, mode)
         fn = self._chunk_fns.get(key)
         if fn is None:
             mcfg = self.model_cfg
 
-            def extend(state, params, tokens, start, valid, slot, row_win):
+            def extend(state, params, tokens, start, valid, slot, row_win,
+                       *seed):
                 C = tokens.shape[1]
                 positions = (start + jnp.arange(C, dtype=jnp.int32))[None, :]
                 _, cache = llama.apply_prefill_paged(
@@ -980,20 +1026,22 @@ class Engine:
                 return dict(state,
                             cache=self._pin_cache(cache),
                             seen=self._chunk_seen(state, tokens, start,
-                                                  valid, slot, first))
+                                                  valid, slot, mode,
+                                                  *seed))
 
             fn = jax.jit(extend, donate_argnums=(0,))
             self._chunk_fns[key] = fn
         return fn
 
-    def _chunk_final_fn(self, window: int, greedy: bool):
+    def _chunk_final_fn(self, window: int, greedy: bool, seed: bool):
         """The LAST chunk: paged prefill + first-token sample + slot
         arming in one dispatch — insert()'s non-cache half (the chunk
         loop already scattered all prompt KV). Only the sampling
-        position is unembedded, not the whole chunk."""
-        # always a non-first chunk: the chunked path only runs for
-        # n_chunks >= 2, so the seen mask was already reset by chunk 0
-        key = ("final", window, greedy)
+        position is unembedded, not the whole chunk. ``seed``: this is
+        ALSO the first chunk (single-chunk prefix-cache hit), so the
+        seen mask seeds from the host-built prefix mask instead of the
+        slot's accumulated one."""
+        key = ("final", window, greedy, seed)
         fn = self._chunk_fns.get(key)
         if fn is None:
             mcfg = self.model_cfg
@@ -1001,7 +1049,7 @@ class Engine:
 
             def final(state, params, tokens, start, valid, slot, row,
                       row_win, temp, top_k, top_p, rep_pen, banned,
-                      bad_seq, bad_len, key_, remaining, eos_ok):
+                      bad_seq, bad_len, key_, remaining, eos_ok, *seed0):
                 C = tokens.shape[1]
                 positions = (start + jnp.arange(C, dtype=jnp.int32))[None, :]
                 h, cache = llama.apply_prefill_paged(
@@ -1009,7 +1057,8 @@ class Engine:
                     row_win, valid[None], start // self.cfg.page_size,
                     with_logits=False)
                 seen = self._chunk_seen(state, tokens, start, valid, slot,
-                                        first=False)
+                                        "seed" if seed else "accum",
+                                        *seed0)
                 idx = jnp.clip(valid - start - 1, 0, C - 1)
                 h_last = jnp.take_along_axis(
                     h, idx[None, None, None].astype(jnp.int32), axis=1)
@@ -1052,48 +1101,76 @@ class Engine:
 
     def _admit_chunked(self, req: _Request, sp: SamplingParams, slot: int,
                        row: np.ndarray, banned, bad_seq, bad_len,
-                       key) -> jax.Array:
-        """Stream a longer-than-any-bucket prompt through the paged pool
-        in chunk-size pieces; returns the first sampled token (device).
-        Each chunk is its own dispatch — long-prompt TTFT pays
-        n_chunks round trips, which only long prompts ever see."""
-        C = self._buckets[-1]
+                       key, start_tok: int = 0,
+                       seen0: Optional[np.ndarray] = None) -> jax.Array:
+        """Stream a prompt's uncached tail through the paged pool in
+        chunk-size pieces; returns the first sampled token (device).
+        Each chunk is its own dispatch.
+
+        Two callers: longer-than-any-bucket prompts (``start_tok`` 0,
+        n_chunks round trips only long prompts ever see) and
+        prefix-cache hits (``start_tok`` = the page-aligned first
+        uncached token; the matched prefix is already mapped in ``row``
+        and each chunk's attention reads it straight from the pool — the
+        common warm-turn case is ONE dispatch for a short suffix).
+        ``seen0``: host-built (V,) seen mask over the cached prefix
+        tokens, folded into the first chunk's dispatch (a separate
+        seeding dispatch would put a whole device round trip back on the
+        TTFT path)."""
         n = len(req.prompt_ids)
-        n_chunks = _ceil_div(n, C)
+        suffix = n - start_tok
+        # Cold long prompts stream at the largest bucket; a cache hit's
+        # suffix picks the smallest covering bucket so a short follow-up
+        # turn doesn't pay a max-bucket prefill for 50 new tokens.
+        C = (self._buckets[-1] if suffix > self._buckets[-1]
+             else self._bucket_for(suffix))
+        n_chunks = _ceil_div(suffix, C)
         page = self.cfg.page_size
         # The gather window must cover the PADDED chunk span, not just the
         # request extent: a final chunk whose padding runs past the window
         # would make dynamic_update_slice/dynamic_slice CLAMP their starts
         # and silently relocate its KV over the prompt's own pages
         # (review catch). Pages past the extent map to the trash page 0.
-        span_pages = n_chunks * (C // page)
+        span_pages = start_tok // page + n_chunks * (C // page)
         window = max(self._window_for(_ceil_div(req.extent, page)),
                      span_pages)
         row_ext = np.zeros((window,), np.int32)
         row_ext[:min(len(row), window)] = row[:min(len(row), window)]
         row_win = jnp.asarray(row_ext[None, :])
-        padded = req.prompt_ids + [0] * (n_chunks * C - n)
+        padded = list(req.prompt_ids[start_tok:]) \
+            + [0] * (n_chunks * C - suffix)
+        seed_arr = None if seen0 is None else jnp.asarray(seen0)
         first_tok = None
         for i in range(n_chunks):
             toks = jnp.asarray(np.asarray(
                 padded[i * C:(i + 1) * C], np.int32)[None, :])
-            start = jnp.int32(i * C)
-            valid = jnp.int32(min(n, (i + 1) * C))
+            start = jnp.int32(start_tok + i * C)
+            valid = jnp.int32(min(n, start_tok + (i + 1) * C))
+            seeding = i == 0 and seed_arr is not None
             self._guard_live()
             if i < n_chunks - 1:
-                new_state = self._chunk_extend_fn(window, i == 0)(
-                    self._state, self.params, toks, start, valid,
-                    jnp.int32(slot), row_win)
+                if seeding:
+                    new_state = self._chunk_extend_fn(window, "seed")(
+                        self._state, self.params, toks, start, valid,
+                        jnp.int32(slot), row_win, seed_arr)
+                else:
+                    mode = ("replace" if i == 0 and start_tok == 0
+                            else "accum")
+                    new_state = self._chunk_extend_fn(window, mode)(
+                        self._state, self.params, toks, start, valid,
+                        jnp.int32(slot), row_win)
             else:
+                args = (self._state, self.params, toks, start, valid,
+                        jnp.int32(slot), jnp.asarray(row), row_win,
+                        jnp.float32(sp.temperature), jnp.int32(sp.top_k),
+                        jnp.float32(sp.top_p),
+                        jnp.float32(sp.repetition_penalty), banned, bad_seq,
+                        bad_len, key, jnp.int32(req.eff_max - 1),
+                        jnp.bool_(not sp.ignore_eos))
+                if seeding:
+                    args = args + (seed_arr,)
                 new_state, first_tok = self._chunk_final_fn(
-                    window, req.greedy)(
-                    self._state, self.params, toks, start, valid,
-                    jnp.int32(slot), jnp.asarray(row), row_win,
-                    jnp.float32(sp.temperature), jnp.int32(sp.top_k),
-                    jnp.float32(sp.top_p),
-                    jnp.float32(sp.repetition_penalty), banned, bad_seq,
-                    bad_len, key, jnp.int32(req.eff_max - 1),
-                    jnp.bool_(not sp.ignore_eos))
+                    window, req.greedy, seeding)(*args)
             self._guard_live()
             self._state = new_state
         return first_tok
@@ -1149,6 +1226,11 @@ class Engine:
         self._slots.clear()
         self._free_slots = list(range(self.cfg.max_slots))
         self._free_pages = list(range(1, self._n_pages))
+        if self._prefix_cache is not None:
+            # Fresh instance, not .clear(): a disowned loop thread may
+            # still hold the old object — its stale mutations must land
+            # on garbage, never on the rebuilt pool's index.
+            self._prefix_cache = PrefixCache(self.cfg.page_size)
         self._fatal = None
         # Drop the old pool BEFORE allocating the new one — holding both
         # across the rebuild doubles pool HBM exactly when recovering
@@ -1478,6 +1560,49 @@ class Engine:
                 return w
         return self._pmax
 
+    def _prefix_lookup(self, req: _Request):
+        """Match the prompt's full page-sized blocks against the prefix
+        cache and take refs on the usable prefix. Returns
+        ``(hashes, k_use, pages)``: the prompt's block-chain hashes, how
+        many leading blocks to map read-only, and their physical pages.
+
+        ``usable_prefix_tokens`` caps a full-cover match one block short
+        (COW demotion): the tail block the request must recompute — at
+        least one token has to run through prefill for first-token
+        logits — gets a PRIVATE page instead of the shared one, so the
+        write never lands on cache property. Fused-RAG requests skip the
+        cache: their prompt is assembled on-device and the host never
+        sees its tokens."""
+        if self._prefix_cache is None or req.rag is not None \
+                or not req.prompt_ids:
+            return [], 0, []
+        page = self.cfg.page_size
+        if req.block_hashes is None:  # backpressure retries re-enter here
+            req.block_hashes = hash_blocks(req.prompt_ids, page)
+        hashes = req.block_hashes
+        matched = self._prefix_cache.match(hashes)
+        k_use = usable_prefix_tokens(matched, len(req.prompt_ids),
+                                     page) // page
+        if k_use == 0:
+            return hashes, 0, []
+        return hashes, k_use, self._prefix_cache.acquire(hashes[:k_use])
+
+    def _register_prefix(self, req: _Request, hashes: list,
+                         k_use: int) -> None:
+        """Hand the freshly prefilled full prompt blocks to the cache
+        (they hold pure prompt KV: decode writes always land past the
+        last full block, see prefix_cache.py). Blocks whose chain hash
+        is already cached — e.g. the COW-demoted tail recomputed into a
+        private page — keep their page private; it frees normally at
+        retire."""
+        if self._prefix_cache is None or req.rag is not None:
+            return
+        for i in range(k_use, len(hashes)):
+            parent = hashes[i - 1] if i else None
+            if self._prefix_cache.insert(hashes[i], parent, req.pages[i]):
+                req.cache_refs.append(hashes[i])
+                req.cache_pages.add(req.pages[i])
+
     def _run(self) -> None:
         from ..obs.tracing import record_stage
         gen = self._gen
@@ -1574,16 +1699,42 @@ class Engine:
                 req.stream._finish("cancelled")
                 continue
             n_alloc = _ceil_div(req.extent, self.cfg.page_size)
-            if n_alloc > len(self._free_pages):
-                break  # pool backpressure: wait for pages to free up
+            # Shared-prefix match: map the longest cached block chain of
+            # this prompt read-only (refs taken NOW so pool-pressure
+            # eviction below can't reclaim it out from under us).
+            hashes, k_use, hit_pages = self._prefix_lookup(req)
+            start_tok = k_use * self.cfg.page_size
+            need_new = n_alloc - k_use
+            if need_new > len(self._free_pages):
+                # Pool pressure: reclaim retired requests' warm prefix
+                # pages (refcount 0, LRU leaf-first) before declaring
+                # backpressure — the cache borrows pool pages, it never
+                # shrinks serving capacity.
+                if self._prefix_cache is not None:
+                    self._free_pages.extend(self._prefix_cache.evict(
+                        need_new - len(self._free_pages)))
+                if need_new > len(self._free_pages):
+                    if k_use:
+                        self._prefix_cache.release(hashes[:k_use])
+                    break  # pool backpressure: wait for pages to free up
             self._head = None
             self._admitting = req  # tracked through the prefill dispatch
             slot = self._free_slots.pop()
             req.slot = slot
-            req.pages = [self._free_pages.pop() for _ in range(n_alloc)]
+            req.pages = hit_pages + [self._free_pages.pop()
+                                     for _ in range(need_new)]
+            req.cache_refs = list(hashes[:k_use])
+            req.cache_pages = set(hit_pages)
             req.proj_pos = len(req.prompt_ids)
             row = np.zeros((self._pmax,), np.int32)
             row[:n_alloc] = req.pages
+            if self._prefix_cache is not None and req.rag is None:
+                st = self._prefix_cache.stats
+                st.lookups += 1
+                st.lookup_tokens += len(req.prompt_ids)
+                if start_tok:
+                    st.hits += 1
+                    st.hit_tokens += start_tok
 
             from ..obs.tracing import record_stage
             record_stage("engine_admit_pickup",
@@ -1619,6 +1770,22 @@ class Engine:
                     bad_len, key,
                     jnp.int32(req.eff_max - 1), jnp.bool_(not sp.ignore_eos),
                     req.greedy)
+            elif start_tok > 0:
+                # Prefix-cache hit: the matched pages are already mapped
+                # in ``row``; prefill starts at the first uncached token
+                # and reads the shared prefix straight from the pool.
+                # The seen (repetition-penalty) mask over the skipped
+                # prefix is rebuilt host-side from the prompt itself and
+                # seeded into the first chunk's dispatch.
+                V = self.model_cfg.vocab_size
+                seen0 = np.zeros((V,), bool)
+                ids = np.asarray(req.prompt_ids[:start_tok], np.int64)
+                seen0[ids[(ids >= 0) & (ids < V)]] = True
+                first_tok = self._admit_chunked(req, sp, slot, row,
+                                                banned, bad_seq, bad_len,
+                                                key, start_tok=start_tok,
+                                                seen0=seen0)
+                new_state = self._state  # committed chunk-by-chunk
             elif len(req.prompt_ids) > self._buckets[-1]:
                 # Long-prompt admission: the prompt streams through the
                 # paged pool in bucket-size chunks (each chunk attends
@@ -1643,6 +1810,7 @@ class Engine:
                     req.greedy)
             self._guard_live()
             self._state = new_state
+            self._register_prefix(req, hashes, k_use)
             record_stage("engine_admit_dispatch",
                          time.monotonic() - t_dispatch)
             try:
@@ -1780,7 +1948,16 @@ class Engine:
     def _retire(self, req: _Request, finish: str) -> None:
         del self._slots[req.slot]
         self._free_slots.append(req.slot)
-        self._free_pages.extend(req.pages)
+        # Pages under cache control stay resident (warm for the next
+        # shared-prefix request) instead of returning to the free list;
+        # releasing the refs afterwards makes them reclaimable at LRU
+        # order once no live request maps them.
+        self._free_pages.extend(p for p in req.pages
+                                if p not in req.cache_pages)
+        if req.cache_refs:
+            self._prefix_cache.release(req.cache_refs)
         req.pages = []
+        req.cache_refs = []
+        req.cache_pages = set()
         if not req.done:  # a failed stream keeps its "error" reason
             req.stream._finish(finish)

@@ -142,6 +142,22 @@ class Registry:
 REGISTRY = Registry()
 
 
+def record_engine_stats(stats: dict, registry: Registry = REGISTRY,
+                        prefix: str = "engine_") -> None:
+    """Mirror an engine ``stats()`` snapshot into the registry as gauges
+    (``engine_requests``, ``engine_prefix_cache_hit_tokens``,
+    ``engine_prefix_cache_hit_rate``, ``engine_prefix_cache_evicted_pages``,
+    ...). Scrape-time pull rather than push-per-event: the engine's hot
+    paths never touch the registry lock, and /metrics always reflects
+    the live counters — including the prefix-cache hit/eviction numbers
+    the warm-TTFT story depends on (chains/server.py wires this into
+    its /metrics endpoint)."""
+    for key, value in stats.items():
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            continue
+        registry.gauge(prefix + key).set(float(value))
+
+
 class RequestTimer:
     """Per-request serving metrics: TTFT, duration, token throughput.
 

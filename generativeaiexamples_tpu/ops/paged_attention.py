@@ -339,6 +339,15 @@ def _paged_attention_decode_quant(q, pool_k, pool_v, pool_ks, pool_vs,
             m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
             alpha = jnp.exp(m - m_new)
             p = jnp.exp(scores - m_new)                        # (KV,G,page)
+            # Zero masked probabilities AND scales explicitly before the
+            # PV dot: p underflows to ~0 for masked lanes, but the scale
+            # lanes beyond `length` hold whatever bytes the page carries
+            # (garbage on a fresh page), and 0 * NaN = NaN would poison
+            # the accumulator. Prefix-cache page sharing makes page-
+            # content invariants load-bearing — same hygiene as the
+            # sibling _paged_prefix_attention.
+            p = jnp.where(valid, p, 0.0)
+            vs = jnp.where(valid[0], vs, 0.0)
             l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
             pv = jax.lax.dot_general(
                 (p * vs[:, None, :]).astype(cd), vp,
@@ -382,12 +391,17 @@ def _paged_attention_decode_quant(q, pool_k, pool_v, pool_ks, pool_vs,
         vrw[:] = jnp.where(row_mask, v_int[:, None, :], src_v)
         # Scale block: lane `off` takes the new scale, every other lane
         # keeps the streamed page's value (garbage on a fresh page — rows
-        # >= length are never attended).
+        # >= length are never attended). When NO page was streamed
+        # (n_pages == 0: a trash-page append for an inactive slot) the
+        # double buffer is uninitialized VMEM — fill the other lanes
+        # with zeros instead of copying a possible NaN bit pattern into
+        # the pool.
         lane = jax.lax.broadcasted_iota(jnp.int32, (1, page), 1) == off
+        streamed = n_pages > 0
         ksrw[:] = jnp.where(lane, k_s[:, None].astype(jnp.bfloat16),
-                            ksbuf[lslot])
+                            jnp.where(streamed, ksbuf[lslot], 0))
         vsrw[:] = jnp.where(lane, v_s[:, None].astype(jnp.bfloat16),
-                            vsbuf[lslot])
+                            jnp.where(streamed, vsbuf[lslot], 0))
         writes = [
             pltpu.make_async_copy(
                 krw, opk_ref.at[li, wp, :, pl.ds(tile0, _TILE)],

@@ -155,7 +155,8 @@ def build_services(model_type: str = "dev", model_name: str = "",
                    max_slots: int = 8, dtype: str = "bfloat16",
                    quantization: str = "", with_embedder: bool = True,
                    seed: int = 0, max_prefill_bucket: Optional[int] = None,
-                   page_size: int = 0, kv_quant: str = ""):
+                   page_size: int = 0, kv_quant: str = "",
+                   prefix_cache: bool = True):
     """Create (engine, embed_service, model_name) per the CLI/config."""
     import jax
     import jax.numpy as jnp
@@ -182,7 +183,8 @@ def build_services(model_type: str = "dev", model_name: str = "",
         max_slots=max_slots, max_input_length=max_input_length,
         max_output_length=max_output_length, dtype=dtype, seed=seed,
         max_prefill_bucket=max_prefill_bucket,
-        page_size=page_size or EngineConfig.page_size, kv_quant=kv_quant)
+        page_size=page_size or EngineConfig.page_size, kv_quant=kv_quant,
+        prefix_cache=prefix_cache)
 
     world, tp, pp = resolve_topology(world_size, tp, pp)
     mesh = make_mesh(MeshPlan(tp=tp, pp=pp), jax.devices()[:world]) \
@@ -456,6 +458,11 @@ def main(argv: Optional[list[str]] = None) -> None:
     parser.add_argument("--max-batch-size", type=int, default=8)
     parser.add_argument("--dtype", default="bfloat16")
     parser.add_argument("--no-embedder", action="store_true")
+    parser.add_argument("--no-prefix-cache", action="store_true",
+                        help="disable shared-prefix KV page reuse across "
+                             "requests (engine/prefix_cache.py); on by "
+                             "default — repeat-turn chat prefills only "
+                             "the new suffix")
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=8000)
     parser.add_argument("--grpc-port", type=int, default=8001,
@@ -485,7 +492,8 @@ def main(argv: Optional[list[str]] = None) -> None:
         max_slots=args.max_batch_size, dtype=args.dtype,
         with_embedder=not args.no_embedder,
         max_prefill_bucket=args.max_prefill_bucket or None,
-        page_size=args.page_size, kv_quant=args.kv_quant)
+        page_size=args.page_size, kv_quant=args.kv_quant,
+        prefix_cache=not args.no_prefix_cache)
     engine.start()
     grpc_server = None  # keep the reference: grpc.Server stops when GC'd
     if args.grpc_port:

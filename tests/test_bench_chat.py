@@ -1,0 +1,60 @@
+"""Tier-1 smoke for the bench's multi-turn chat scenario.
+
+Runs bench.run_chat_bench against a tiny CPU engine so the whole
+prefix-cache serving path (hash -> match -> mapped pages -> suffix-chunk
+prefill -> refcounted release) executes inside the fast test suite, not
+only on TPU bench runs. Wall-clock TTFT ordering is NOT asserted here —
+CPU timing is noise — the contract is that warm turns hit the cache
+(``prefix_cache_hit_tokens`` > 0) and the scenario reports the fields
+the BENCH_r06 artifact publishes.
+"""
+
+import jax
+import jax.numpy as jnp
+
+import bench
+from generativeaiexamples_tpu.engine import Engine, EngineConfig
+from generativeaiexamples_tpu.models import llama
+from generativeaiexamples_tpu.models.configs import LlamaConfig
+from generativeaiexamples_tpu.models.tokenizer import ByteTokenizer
+
+CFG = LlamaConfig(vocab_size=259 + 5, hidden_size=64, intermediate_size=128,
+                  num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+                  max_position_embeddings=512)
+
+
+def test_chat_scenario_hits_prefix_cache_on_cpu():
+    params = llama.init_params(CFG, jax.random.key(5), dtype=jnp.float32)
+    eng = Engine(params, CFG, ByteTokenizer(), EngineConfig(
+        max_slots=2, max_input_length=256, max_output_length=16,
+        prefill_buckets=(32, 64), page_size=16, dtype="float32",
+        kv_pool_tokens=None, steps_per_round=4))
+    with eng:
+        res = bench.run_chat_bench(eng, n_turns=3, system_len=48,
+                                   user_len=10, reply_len=4)
+    assert res["turns"] == 3
+    assert res["cold_ttft_ms"] is not None
+    assert res["warm_p50_ttft_ms"] is not None
+    assert len(res["warm_ttfts_ms"]) == 2
+    # warm turns reused the cached conversation prefix: prefill started
+    # at the first uncached token, not at token 0
+    assert res["prefix_cache_hit_tokens"] > 0
+    assert 0 < res["prefix_cache_hit_rate"] <= 1
+    # every page is either free or warm in the cache afterwards
+    cached = eng._prefix_cache.cached_pages
+    assert len(eng._free_pages) + cached == eng._n_pages - 1
+
+
+def test_chat_scenario_survives_cache_disabled():
+    """BENCH comparability rung: the scenario itself must run (and report
+    zero hits) when the engine's prefix cache is off."""
+    params = llama.init_params(CFG, jax.random.key(5), dtype=jnp.float32)
+    eng = Engine(params, CFG, ByteTokenizer(), EngineConfig(
+        max_slots=2, max_input_length=256, max_output_length=16,
+        prefill_buckets=(32, 64), page_size=16, dtype="float32",
+        kv_pool_tokens=None, steps_per_round=4, prefix_cache=False))
+    with eng:
+        res = bench.run_chat_bench(eng, n_turns=2, system_len=48,
+                                   user_len=10, reply_len=4, warmup=False)
+    assert res["prefix_cache_hit_tokens"] == 0
+    assert res["prefix_cache_hit_rate"] == 0.0

@@ -344,6 +344,30 @@ def test_apply_rejection_requeues_then_recovers():
     assert _cr_status(kube, pipe)["conditions"][0]["status"] == "True"
 
 
+def test_watch_404_raises_crd_missing_not_nonetype_loop(monkeypatch):
+    """Regression (ADVICE r5): a 404 on the watch stream (CRD not yet
+    installed) used to map to None like any GET miss, so the caller's
+    iteration died with 'NoneType is not iterable' and the finally's
+    resp.close() raised AttributeError — a confusing busy loop instead
+    of the actual problem. Stream requests must raise the real cause;
+    plain GET misses still map to None."""
+    import io
+    from urllib import error as urlerror
+
+    from generativeaiexamples_tpu.deploy import apiserver as apimod
+
+    client = apimod.ApiServerKube(base_url="http://fake.invalid", token="t")
+
+    def raise_404(req, timeout=None, context=None):
+        raise urlerror.HTTPError(req.full_url, 404, "not found", {},
+                                 io.BytesIO(b"no helmpipelines here"))
+
+    monkeypatch.setattr(apimod.urlrequest, "urlopen", raise_404)
+    with pytest.raises(RuntimeError, match="CRD not installed"):
+        list(client.watch(API_VERSION, KIND))
+    assert client.get((API_VERSION, KIND, "default", "missing")) is None
+
+
 def test_iter_json_stream_reassembles_watch_events():
     """kubectl --watch emits unframed concatenated JSON documents; the
     parser must reassemble them across arbitrary chunk boundaries."""

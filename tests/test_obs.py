@@ -161,3 +161,24 @@ def test_maybe_init_distributed():
                           cwd=os.path.dirname(os.path.dirname(
                               os.path.abspath(__file__))))
     assert "DIST_OK" in proc.stdout, proc.stderr[-2000:]
+
+
+def test_record_engine_stats_mirrors_numeric_stats_as_gauges():
+    """Scrape-time engine snapshot for /metrics (chains/server.py): every
+    numeric engine stat becomes an engine_* gauge; strings and bools
+    (e.g. future flags) are skipped rather than rendered as garbage."""
+    from generativeaiexamples_tpu.obs.metrics import record_engine_stats
+
+    reg = Registry()
+    record_engine_stats({"requests": 3, "prefix_cache_hit_tokens": 512,
+                         "prefix_cache_hit_rate": 0.5,
+                         "prefix_cache_evicted_pages": 2,
+                         "kind": "paged", "steady": True}, registry=reg)
+    snap = reg.snapshot()
+    assert snap["engine_requests"] == 3.0
+    assert snap["engine_prefix_cache_hit_tokens"] == 512.0
+    assert snap["engine_prefix_cache_hit_rate"] == 0.5
+    assert "engine_kind" not in snap and "engine_steady" not in snap
+    text = reg.render_prometheus()
+    assert "engine_prefix_cache_hit_rate 0.5" in text
+    assert "engine_prefix_cache_evicted_pages 2" in text
