@@ -66,13 +66,18 @@ def _cmd_reconcile(args) -> int:
     return 1 if result.error else 0
 
 
-def _resync(list_pipelines, op) -> None:
+def _resync(list_pipelines, op, lost=None) -> None:
     try:
         items = list_pipelines()
     except Exception as exc:  # noqa: BLE001 — transient apiserver trouble
         print(f"list helmpipelines failed: {exc}", file=sys.stderr)
         return
     for item in items:
+        if lost is not None and lost():
+            # Leadership dropped mid-resync: the new leader's own resync
+            # covers the rest; reconciling further would split-brain.
+            print("leadership lost mid-resync; stopping", file=sys.stderr)
+            return
         pipeline = HelmPipeline.from_manifest(item)
         result = op.reconcile(pipeline)
         if result.error:
@@ -96,9 +101,13 @@ def _handle_event(op, event: dict) -> None:
                   f"({result.error})", file=sys.stderr)
 
 
-def _watch_once_kubectl(kube, op, interval: int) -> None:
+def _watch_once_kubectl(kube, op, interval: int, lost=None) -> None:
     """One watch window via a kubectl subprocess pipe (the driver-binary
-    path; the --client api path needs no binary at all)."""
+    path; the --client api path needs no binary at all). ``lost``: the
+    leader-election loss signal — a sentinel thread polls it and
+    TERMINATES the kubectl pipe the moment leadership drops, so the
+    blocked readline unwinds within ~0.5 s instead of holding the old
+    leader's reconcile loop open for the rest of the window."""
     import subprocess
     import threading
 
@@ -114,6 +123,14 @@ def _watch_once_kubectl(kube, op, interval: int) -> None:
     timer = threading.Timer(interval, proc.terminate)
     timer.daemon = True
     timer.start()
+    ended = threading.Event()
+    if lost is not None:
+        def sentinel() -> None:
+            while not ended.wait(0.5):
+                if lost():
+                    proc.terminate()
+                    return
+        threading.Thread(target=sentinel, daemon=True).start()
     try:
         def chunks():
             while True:
@@ -122,8 +139,11 @@ def _watch_once_kubectl(kube, op, interval: int) -> None:
                     return
                 yield line
         for event in iter_json_stream(chunks()):
+            if lost is not None and lost():
+                return  # the finally below reaps the pipe
             _handle_event(op, event)
     finally:
+        ended.set()
         timer.cancel()
         proc.terminate()
         try:
@@ -144,8 +164,8 @@ def _cmd_watch(args) -> int:
         kube = ApiServerKube()
         list_pipelines = lambda: kube.list_resources(  # noqa: E731
             api_version, "HelmPipeline")
-        watch_once = lambda: _watch_once_api_stream(  # noqa: E731
-            kube, op, api_version, args.interval)
+        watch_once = lambda lost=None: _watch_once_api_stream(  # noqa: E731
+            kube, op, api_version, args.interval, lost=lost)
     else:
         kube = KubectlKube()
 
@@ -155,19 +175,28 @@ def _cmd_watch(args) -> int:
                 raise RuntimeError(proc.stderr.strip())
             return json.loads(proc.stdout).get("items", [])
 
-        watch_once = lambda: _watch_once_kubectl(  # noqa: E731
-            kube, op, args.interval)
+        watch_once = lambda lost=None: _watch_once_kubectl(  # noqa: E731
+            kube, op, args.interval, lost=lost)
 
     op = PipelineOperator(kube, chart_search_path=args.charts)
 
-    def one_cycle():
+    def one_cycle(lost=None):
         # Full resync first (startup + every reconnect): catches CRs whose
         # events were missed while the watch was down, and re-runs errored
-        # pipelines — the controller-runtime resync analogue.
+        # pipelines — the controller-runtime resync analogue. ``lost`` is
+        # the elector's leadership-loss signal (leader.py run): it is
+        # checked between reconciles, tears down the watch stream, and
+        # cuts the tail sleep short — a deposed leader stops reconciling
+        # within ~a renew interval, not a full watch/resync window
+        # (ADVICE r5 #2).
         deadline = time.time() + args.interval
-        _resync(list_pipelines, op)
-        watch_once()
-        time.sleep(max(0.0, deadline - time.time()))
+        _resync(list_pipelines, op, lost=lost)
+        if lost is None or not lost():
+            watch_once(lost)
+        while time.time() < deadline:
+            if lost is not None and lost():
+                return
+            time.sleep(min(0.5, max(0.0, deadline - time.time())))
 
     if args.leader_elect:
         from .leader import LeaderElector
@@ -182,13 +211,17 @@ def _cmd_watch(args) -> int:
 
 
 def _watch_once_api_stream(kube, op, api_version: str,
-                           interval: int) -> None:
+                           interval: int, lost=None) -> None:
     """One watch window over direct apiserver HTTPS (?watch=1 stream);
     the server closes the window after ``interval`` seconds, which is
-    the outer loop's natural resync point."""
+    the outer loop's natural resync point. ``lost`` (leadership-loss
+    signal) is handed to kube.watch, which closes the stream when it
+    flips — the blocked read unwinds instead of riding out the window."""
     try:
         for event in kube.watch(api_version, "HelmPipeline",
-                                timeout_seconds=interval):
+                                timeout_seconds=interval, stop=lost):
+            if lost is not None and lost():
+                return
             _handle_event(op, event)
     except Exception as exc:  # noqa: BLE001 — reconnect via outer loop
         print(f"watch stream ended: {exc}", file=sys.stderr)

@@ -32,7 +32,7 @@ from __future__ import annotations
 import json
 import os
 import ssl
-from typing import Iterable, Optional
+from typing import Callable, Iterable, Optional
 from urllib import error as urlerror
 from urllib import parse as urlparse
 from urllib import request as urlrequest
@@ -231,27 +231,76 @@ class ApiServerKube(KubeInterface):
     # -------------------------------------------------------------- watch
 
     def watch(self, api_version: str, kind: str,
-              timeout_seconds: int = 30) -> Iterable[dict]:
+              timeout_seconds: int = 30,
+              stop: Optional[Callable[[], bool]] = None) -> Iterable[dict]:
         """Stream watch events ({"type", "object"} dicts) for a resource
-        across all namespaces until the server closes the window."""
+        across all namespaces until the server closes the window.
+
+        ``stop``: optional cancellation signal (e.g. the leader
+        elector's leadership-loss flag, deploy/leader.py run). A
+        sentinel thread polls it every 0.5 s and CLOSES the HTTP stream
+        when it flips, unblocking a read that would otherwise sit in
+        recv() for the rest of a quiet window — the watch ends within
+        ~0.5 s of the signal instead of at the window boundary. The
+        iterator also re-checks the signal between events."""
         path = resource_path(api_version, kind, "x")
         head, _, plural = path.rpartition("/")
         head = head.rsplit("/namespaces/", 1)[0]
         resp = None
+        ended = None
         try:
             resp = self._request(
                 "GET", f"{head}/{plural}", stream=True,
                 query={"watch": "1", "timeoutSeconds": str(timeout_seconds)},
                 timeout=timeout_seconds + 10)
-            for raw in resp:
-                line = raw.decode(errors="replace").strip()
-                if not line:
-                    continue
-                try:
-                    yield json.loads(line)
-                except json.JSONDecodeError:
-                    continue  # torn line at window close
+            if stop is not None:
+                import socket as _socket
+                import threading
+                ended = threading.Event()
+                closing = resp
+
+                def sentinel() -> None:
+                    while not ended.wait(0.5):
+                        if stop():
+                            # close() alone does NOT interrupt a recv()
+                            # blocked in another thread on Linux (and
+                            # racing close() against the reader trips
+                            # AttributeErrors inside http.client) — a
+                            # TCP-level shutdown makes the blocked read
+                            # see EOF immediately; the reader's finally
+                            # does the actual close.
+                            try:
+                                sock = getattr(
+                                    getattr(closing, "fp", None), "raw",
+                                    None)
+                                sock = getattr(sock, "_sock", None)
+                                if sock is not None:
+                                    sock.shutdown(_socket.SHUT_RDWR)
+                            except Exception:  # noqa: BLE001 — best effort
+                                pass
+                            return
+                threading.Thread(target=sentinel, daemon=True).start()
+            try:
+                for raw in resp:
+                    if stop is not None and stop():
+                        return
+                    line = raw.decode(errors="replace").strip()
+                    if not line:
+                        continue
+                    try:
+                        yield json.loads(line)
+                    except json.JSONDecodeError:
+                        continue  # torn line at window close
+            except Exception:
+                # teardown noise from the sentinel's shutdown (torn
+                # chunked frame, half-closed fp) — only swallow it when
+                # the stop signal actually fired
+                if stop is not None and stop():
+                    return
+                raise
         finally:
+            if ended is not None:
+                ended.set()
             # guard: _request raising leaves resp unset — an unguarded
             # close() would mask the real error with an AttributeError
             if resp is not None:

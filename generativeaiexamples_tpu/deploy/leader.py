@@ -137,7 +137,7 @@ class LeaderElector:
 
     # ------------------------------------------------------------ run loop
 
-    def run(self, while_leading: Callable[[], None],
+    def run(self, while_leading: Callable[..., None],
             renew_seconds: float = 5.0,
             retry_seconds: float = 2.0,
             stop: Optional[Callable[[], bool]] = None) -> None:
@@ -148,8 +148,27 @@ class LeaderElector:
         and without concurrent renewal every cycle would expire the
         lease mid-reconcile and hand a standby a split brain. A failed
         renewal drops ``is_leader``; the loop stops invoking the
-        callback after the cycle in flight and returns to candidacy."""
+        callback after the cycle in flight.
+
+        Leadership loss is additionally propagated INTO the in-flight
+        cycle: a ``while_leading`` that accepts an argument receives a
+        ``lost() -> bool`` callable, flipped by the renewer the moment a
+        renewal fails. Callbacks are expected to poll it between work
+        items and to tear down blocking streams (watch windows) when it
+        flips — bounding the old-leader/new-leader overlap to roughly
+        one renew interval instead of a full watch/resync window
+        (ADVICE r5 #2; a zero-argument callback keeps the legacy
+        cycle-granular behavior)."""
+        import inspect
         import threading
+        try:
+            takes_lost = bool(inspect.signature(while_leading).parameters)
+        except (TypeError, ValueError):  # builtins/C callables: legacy path
+            takes_lost = False
+
+        def lost() -> bool:
+            return not self.is_leader or bool(stop and stop())
+
         try:
             while not (stop and stop()):
                 if not self.try_acquire():
@@ -160,12 +179,15 @@ class LeaderElector:
                 def renew() -> None:
                     while not done.wait(renew_seconds):
                         if not self.try_acquire():
-                            return  # is_leader already False
+                            return  # is_leader already False; lost() True
                 renewer = threading.Thread(target=renew, daemon=True)
                 renewer.start()
                 try:
                     while self.is_leader and not (stop and stop()):
-                        while_leading()
+                        if takes_lost:
+                            while_leading(lost)
+                        else:
+                            while_leading()
                 finally:
                     done.set()
                     renewer.join(timeout=renew_seconds + 1)

@@ -142,6 +142,16 @@ class Registry:
 REGISTRY = Registry()
 
 
+# Engine pipeline stage counters that are cumulative-(ms, events) pairs:
+# record_engine_stats derives a per-event average gauge for each so the
+# scrape shows "how long does one round's readback wait" directly,
+# without PromQL rate division over two engine_* gauges.
+ENGINE_STAGE_AVGS = (
+    ("harvest_wait_ms", "harvest_rounds"),
+    ("first_readback_ms", "first_readbacks"),
+)
+
+
 def record_engine_stats(stats: dict, registry: Registry = REGISTRY,
                         prefix: str = "engine_") -> None:
     """Mirror an engine ``stats()`` snapshot into the registry as gauges
@@ -151,11 +161,24 @@ def record_engine_stats(stats: dict, registry: Registry = REGISTRY,
     paths never touch the registry lock, and /metrics always reflects
     the live counters — including the prefix-cache hit/eviction numbers
     the warm-TTFT story depends on (chains/server.py wires this into
-    its /metrics endpoint)."""
+    its /metrics endpoint).
+
+    The overlapped harvest/dispatch pipeline's per-stage counters flow
+    through here too: ``engine_harvest_wait_ms`` / ``engine_harvest_rounds``
+    (decode-round readback wait, now off the scheduling path),
+    ``engine_first_readback_ms`` / ``engine_first_readbacks`` (first-token
+    readback overlap), and ``engine_dispatch_queue_depth`` (device rounds
+    in flight; >0 during steady decode means the device never idles on the
+    host). Each (total_ms, events) pair additionally publishes an
+    ``engine_<stage>_avg`` gauge (see ENGINE_STAGE_AVGS)."""
     for key, value in stats.items():
         if isinstance(value, bool) or not isinstance(value, (int, float)):
             continue
         registry.gauge(prefix + key).set(float(value))
+    for total_key, count_key in ENGINE_STAGE_AVGS:
+        if stats.get(count_key):
+            registry.gauge(prefix + total_key + "_avg").set(
+                float(stats[total_key]) / float(stats[count_key]))
 
 
 class RequestTimer:

@@ -103,8 +103,21 @@ def resolve_topology(world_size: int = 0, tp: int = 0, pp: int = 1,
                      available: Optional[int] = None) -> tuple[int, int, int]:
     """(world, tp, pp) with the reference's defaulting rules
     (reference: model_server/__init__.py:103-110: tp defaults to world/pp,
-    and TP·PP must equal world size)."""
+    and TP·PP must equal world size).
+
+    ``pp > 1`` is a validated SERVING rejection (the Engine would refuse
+    the mesh anyway — engine/engine.py topology validation — but failing
+    here is milliseconds into startup, before any checkpoint
+    conversion): decode dispatches all layers as one program per round,
+    so pipeline stages would idle 1/pp of every round. Rationale:
+    docs/api-reference.md, "Pipeline-parallel serving"."""
     import jax
+    if pp > 1:
+        raise ConfigError(
+            f"serving requires pp == 1 (got pp={pp}): decode runs all "
+            f"layers as one fused program per round; shard serving over "
+            f"tp/sp instead — pp is training-only (docs/api-reference.md, "
+            f"'Pipeline-parallel serving')")
     if available is None:
         available = len(jax.devices())
     world = world_size or available

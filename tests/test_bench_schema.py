@@ -1,0 +1,111 @@
+"""Tier-1 guard for the bench's output contract.
+
+bench.py's JSON line is the perf trajectory the driver diffs round over
+round; a silently renamed field breaks that comparison without breaking
+the bench. This suite assembles a fully-populated synthetic result
+through the SAME ``bench.assemble_result`` the chip run uses and
+validates it against tools/bench_schema.json — so a field rename in
+either place fails here, on CPU, before any chip time is spent.
+"""
+
+import pytest
+
+import bench
+from tools.check_bench_schema import (BenchSchemaError, load_schema,
+                                      validate_result)
+
+
+def synthetic_result() -> dict:
+    """A result with every branch populated (chat + e2e + pipeline),
+    built through bench.assemble_result so the test pins the real
+    emit path, not a hand-copied dict."""
+    chat = {
+        "turns": 3, "system_prompt_tokens": 512,
+        "cold_ttft_ms": 180.0, "warm_p50_ttft_ms": 120.0,
+        "warm_min_ttft_ms": 110.0, "warm_ttfts_ms": [120.0, 121.5],
+        "prefix_cache_hit_tokens": 1024, "prefix_cache_hit_rate": 0.8,
+        "prefix_cache_evicted_pages": 0,
+    }
+    dist = {"p99": 190.0, "min": 170.0, "max": 190.0,
+            "batch_p50s": [178.0, 180.0, 179.0], "samples": 24}
+    breakdown = {"embedding": 4.0, "retrieve": 1.0, "templating": 0.2,
+                 "llm": 460.0, "llm_first_chunk": 175.0,
+                 "engine_ttft": 172.0, "engine_admit_pickup": 0.4,
+                 "engine_admit_dispatch": 3.2,
+                 "engine_first_readback": 130.0,
+                 "engine_harvest_wait": 140.0,
+                 "loop_admit": 3.5, "loop_dispatch": 2.7}
+    pipeline = bench.pipeline_snapshot({
+        "harvest_wait_ms": 420.0, "harvest_rounds": 3,
+        "first_readback_ms": 260.0, "first_readbacks": 2,
+        "dispatch_depth_peak": 2})
+    return bench.assemble_result(
+        kind="e2e_chat", model="llama-2-7b-chat", headline=178.0,
+        engine_p50=140.0, engine_p99=150.0, tput=500.0,
+        achieved_bw=590.4e9, bw_util=0.72, bw_steady=True,
+        chat=chat, e2e_p50=178.0, e2e_dist=dist, e2e_breakdown=breakdown,
+        pipeline=pipeline, quant="int8", kv_quant=None,
+        weights="random-init", prompt_len=512, out_len=64, slots=8,
+        steps_per_round=16, kv_pool_pages=63, device="TPU v5 lite",
+        rtt_ms=100.8, n_devices=1, bench_seconds=100.0)
+
+
+def test_assembled_result_matches_schema():
+    validate_result(synthetic_result())
+
+
+def test_engine_only_degraded_result_matches_schema():
+    """The BENCH_SKIP_E2E / embedder-failure rung: chat and e2e blocks
+    null out but the contract still validates."""
+    result = synthetic_result()
+    result.update({"chat": None, "e2e_chat_ttft_ms": None,
+                   "e2e_chat_p99_ttft_ms": None, "e2e_ttft_dist_ms": None,
+                   "e2e_breakdown_ms": None})
+    validate_result(result)
+
+
+def test_pipeline_snapshot_keys_pinned_by_schema():
+    """pipeline_snapshot's keys ARE the schema's engine_pipeline section:
+    renaming either side alone fails."""
+    schema = load_schema()
+    snap = bench.pipeline_snapshot({})
+    assert set(snap) == set(schema["engine_pipeline"])
+    # zero-stats snapshot is well-typed (no div-by-zero artifacts)
+    validate_result(dict(synthetic_result(), engine_pipeline=snap))
+
+
+def test_breakdown_stage_rename_fails_fast():
+    result = synthetic_result()
+    # the r5 stage name: the loop no longer blocks on round harvests, so
+    # the stage was renamed — the schema must reject the stale name
+    result["e2e_breakdown_ms"]["loop_hround"] = 284.7
+    with pytest.raises(BenchSchemaError, match="loop_hround"):
+        validate_result(result)
+
+
+def test_missing_required_field_fails_fast():
+    result = synthetic_result()
+    del result["engine_p50_ttft_ms"]
+    with pytest.raises(BenchSchemaError, match="engine_p50_ttft_ms"):
+        validate_result(result)
+
+
+def test_unknown_toplevel_field_fails_fast():
+    result = synthetic_result()
+    result["ttft_p50_ms"] = 140.0  # a rename half-applied
+    with pytest.raises(BenchSchemaError, match="ttft_p50_ms"):
+        validate_result(result)
+
+
+def test_wrong_type_fails_fast():
+    result = synthetic_result()
+    result["decode_tokens_per_sec"] = "494.1"
+    with pytest.raises(BenchSchemaError, match="decode_tokens_per_sec"):
+        validate_result(result)
+
+
+def test_nested_chat_contract_pinned():
+    result = synthetic_result()
+    result["chat"]["warm_ttft_ms"] = 1.0  # unknown chat key
+    with pytest.raises(BenchSchemaError, match="warm_ttft_ms"):
+        validate_result(result)

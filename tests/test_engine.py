@@ -618,3 +618,126 @@ def test_long_prompt_padded_span_beyond_window():
         b = oneshot.submit(prompt, sp)
         a.text(), b.text()
     assert a.token_ids == b.token_ids, (a.token_ids, b.token_ids)
+
+
+def test_stats_expose_pipeline_counters(engine):
+    """The overlapped harvest/dispatch pipeline publishes its stage
+    counters through engine.stats: cumulative readback-wait time (the
+    cost that used to serialize the scheduling loop) and the live
+    device-queue depth."""
+    import time as _time
+
+    s = engine.submit(engine.tokenizer.encode("counters"),
+                      SamplingParams(max_tokens=8, top_k=1,
+                                     ignore_eos=True))
+    s.text()
+    stats = engine.stats
+    for key in ("harvest_wait_ms", "harvest_rounds", "first_readback_ms",
+                "first_readbacks", "dispatch_queue_depth",
+                "dispatch_depth_peak"):
+        assert key in stats, f"stats missing pipeline counter {key}"
+    assert stats["harvest_rounds"] >= 1
+    assert stats["first_readbacks"] >= 1
+    assert stats["dispatch_depth_peak"] >= 1
+    assert stats["harvest_wait_ms"] >= 0.0
+    assert stats["first_readback_ms"] >= 0.0
+    # Terminal sentinels are delivered by the harvest worker BEFORE the
+    # round's depth decrement, so allow the pipeline a moment to settle;
+    # an idle engine must always drain to depth 0.
+    deadline = _time.monotonic() + 10
+    while engine.stats["dispatch_queue_depth"] and \
+            _time.monotonic() < deadline:
+        _time.sleep(0.01)
+    assert engine.stats["dispatch_queue_depth"] == 0
+
+
+def test_threaded_harvest_stress_no_orphans():
+    """Stress the two-thread pipeline specifically: producers hammer
+    submit/cancel (cancel-heavy — host-detected finishes exercise the
+    completion queue's release path) while reset() fires mid-flight
+    against the harvest worker. Invariants beyond the generic stress
+    test: the pipeline itself ends drained (no orphaned in-flight
+    entries, depth counter exactly 0), every slot and page is returned,
+    and stream terminals stay sticky across a second read."""
+    import threading
+    import time as _time
+
+    params = llama.init_params(CFG, jax.random.key(29), dtype=jnp.float32)
+    eng = Engine(params, CFG, ByteTokenizer(), EngineConfig(
+        max_slots=4, max_input_length=64, max_output_length=16,
+        prefill_buckets=(16, 32), dtype="float32", max_queue=256,
+        steps_per_round=4, dispatch_depth=2))
+    eng.start()
+    eng.generate_text("warm", SamplingParams(max_tokens=2, top_k=1,
+                                             ignore_eos=True))
+    stop = _time.monotonic() + 6.0
+    streams, lock = [], threading.Lock()
+    errors = []
+
+    def producer(seed: int):
+        i = 0
+        while _time.monotonic() < stop:
+            i += 1
+            try:
+                s = eng.submit(eng.tokenizer.encode(f"h{seed}-{i}"),
+                               SamplingParams(max_tokens=6 + (i % 7),
+                                              top_k=1, ignore_eos=True))
+            except Exception as exc:  # noqa: BLE001
+                if type(exc).__name__ not in ("EngineError",
+                                              "SchedulerFullError"):
+                    errors.append(exc)
+                continue
+            with lock:
+                streams.append(s)
+            if i % 2 == 0:   # cancel-heavy: stress the release feedback
+                s.cancel()
+            elif i % 5 == 0:
+                try:
+                    s.text()
+                except Exception:  # noqa: BLE001 — reset may fail it
+                    pass
+
+    threads = [threading.Thread(target=producer, args=(k,), daemon=True)
+               for k in range(4)]
+    for t in threads:
+        t.start()
+    _time.sleep(1.5)
+    eng.reset()
+    eng.start()
+    _time.sleep(1.5)
+    eng.reset()
+    eng.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not any(t.is_alive() for t in threads), "producer deadlocked"
+    assert not errors, errors
+    deadline = _time.monotonic() + 60
+    for s in streams:
+        while s.finish_reason is None and _time.monotonic() < deadline:
+            _time.sleep(0.05)
+        assert s.finish_reason is not None, "stream never terminated"
+        # sticky terminal: a second read returns (or re-raises)
+        # immediately instead of blocking on the drained queue
+        for _ in range(2):
+            try:
+                s.text()
+            except Exception:  # noqa: BLE001 — error IS terminal
+                pass
+    # engine still serves correct greedy output after the carnage
+    out = eng.submit(eng.tokenizer.encode("after harvest stress"),
+                     SamplingParams(max_tokens=6, top_k=1, ignore_eos=True))
+    out.text()
+    assert out.token_ids == greedy_reference(
+        params, eng.tokenizer.encode("after harvest stress"), 6)
+    eng.stop()
+    # pipeline fully drained: no orphaned in-flight entries, no slot or
+    # page leaked, depth counter back to exactly zero
+    assert eng._harvest_q.empty()
+    assert eng._completed.empty()
+    assert eng._inflight_rounds == 0
+    assert not eng._slots
+    assert sorted(eng._free_slots) == list(range(4))
+    cached = (eng._prefix_cache.cached_pages
+              if eng._prefix_cache is not None else 0)
+    assert len(set(eng._free_pages)) == len(eng._free_pages)
+    assert len(eng._free_pages) + cached == eng._n_pages - 1

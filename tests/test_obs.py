@@ -182,3 +182,32 @@ def test_record_engine_stats_mirrors_numeric_stats_as_gauges():
     text = reg.render_prometheus()
     assert "engine_prefix_cache_hit_rate 0.5" in text
     assert "engine_prefix_cache_evicted_pages 2" in text
+
+
+def test_record_engine_stats_pipeline_stage_gauges():
+    """The overlapped-pipeline stage counters mirror as engine_* gauges,
+    and each cumulative (ms, events) pair derives a per-event _avg gauge
+    — the scrape answers 'how long does one round's readback wait'
+    without PromQL arithmetic. Zero-event pairs publish no average
+    (never a division by zero or a misleading 0)."""
+    from generativeaiexamples_tpu.obs.metrics import record_engine_stats
+
+    reg = Registry()
+    record_engine_stats({"harvest_wait_ms": 300.0, "harvest_rounds": 3,
+                         "first_readback_ms": 50.0, "first_readbacks": 2,
+                         "dispatch_queue_depth": 1}, registry=reg)
+    snap = reg.snapshot()
+    assert snap["engine_harvest_wait_ms"] == 300.0
+    assert snap["engine_harvest_rounds"] == 3.0
+    assert snap["engine_harvest_wait_ms_avg"] == 100.0
+    assert snap["engine_first_readback_ms_avg"] == 25.0
+    assert snap["engine_dispatch_queue_depth"] == 1.0
+
+    # no events yet: totals mirror, averages stay absent
+    reg2 = Registry()
+    record_engine_stats({"harvest_wait_ms": 0.0, "harvest_rounds": 0,
+                         "first_readback_ms": 0.0, "first_readbacks": 0},
+                        registry=reg2)
+    snap2 = reg2.snapshot()
+    assert "engine_harvest_wait_ms_avg" not in snap2
+    assert "engine_first_readback_ms_avg" not in snap2

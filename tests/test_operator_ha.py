@@ -447,3 +447,86 @@ def test_resource_path_shapes():
     assert resource_path("rbac.authorization.k8s.io/v1", "ClusterRole",
                          name="cr") == \
         "/apis/rbac.authorization.k8s.io/v1/clusterroles/cr"
+
+
+def test_leader_loss_propagates_into_inflight_cycle():
+    """ADVICE r5 #2: after a failed renewal, the in-flight while_leading
+    cycle used to keep reconciling for a full watch/resync window while
+    the new leader reconciled concurrently. run() now hands the cycle a
+    ``lost()`` signal flipped by the renewer — a cycle that polls it
+    (the operator's one_cycle does) exits within ~a renew interval, so
+    the split-brain overlap is bounded well below the cycle length."""
+    import time as _time
+
+    kube = InMemoryKube()
+    a = LeaderElector(kube, "a", lease_seconds=30)   # real clock
+    stop_run = threading.Event()
+    cycle_done = []
+    in_cycle = threading.Event()
+
+    def cycle(lost):
+        in_cycle.set()
+        deadline = _time.monotonic() + 20.0   # the "watch window"
+        while not lost() and _time.monotonic() < deadline:
+            _time.sleep(0.02)
+        cycle_done.append(_time.monotonic())
+        stop_run.set()
+
+    t = threading.Thread(
+        target=lambda: a.run(cycle, renew_seconds=0.05,
+                             retry_seconds=0.05, stop=stop_run.is_set),
+        daemon=True)
+    t.start()
+    assert in_cycle.wait(10), "never became leader"
+    # Usurp the lease: write holderIdentity over to b with a fresh
+    # renewTime, carrying the live resourceVersion — a's next renewal
+    # sees an unexpired foreign holder and drops is_leader.
+    from generativeaiexamples_tpu.deploy import leader as leader_mod
+    cur = kube.get(a.key)
+    cur["spec"]["holderIdentity"] = "b"
+    cur["spec"]["renewTime"] = leader_mod._fmt(leader_mod._now())
+    kube.apply(cur)
+    t_usurp = _time.monotonic()
+    t.join(timeout=10)
+    assert not t.is_alive(), "run() never returned after leadership loss"
+    assert cycle_done, "cycle never exited"
+    # bounded: the 20 s window was cut short within ~renew interval + poll
+    assert cycle_done[0] - t_usurp < 2.0
+    assert not a.is_leader
+
+
+def test_leader_run_zero_arg_callback_still_supported():
+    """Legacy zero-argument cycles keep working (cycle-granular loss
+    handling): run() inspects the callback's signature rather than
+    changing the contract under existing operators."""
+    kube = InMemoryKube()
+    a = LeaderElector(kube, "a", lease_seconds=15)
+    calls = []
+    a.run(lambda: calls.append(1), renew_seconds=0.05, retry_seconds=0.05,
+          stop=lambda: len(calls) >= 2)
+    assert len(calls) >= 2
+
+
+def test_apiserver_watch_stop_unblocks_quiet_stream(api_server):
+    """The leadership-loss signal must tear a QUIET watch stream down:
+    with stop flipping shortly after attach, the watch returns in ~a
+    poll interval instead of riding out the 30 s server window."""
+    import time
+
+    srv, kube = api_server
+    t0 = time.monotonic()
+    flip_at = t0 + 0.5
+    returned = []
+
+    def consume():
+        for _ in kube.watch("package.tpu-rag.dev/v1alpha1",
+                            "HelmPipeline", timeout_seconds=30,
+                            stop=lambda: time.monotonic() >= flip_at):
+            pass
+        returned.append(time.monotonic())
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    t.join(timeout=10)
+    assert not t.is_alive(), "watch did not unblock on stop"
+    assert returned and returned[0] - t0 < 5.0  # far below the 30 s window

@@ -207,10 +207,14 @@ def test_engine_tp_mesh_kernel_path_parity(cpu_devices, monkeypatch):
         got.text()
     assert got.token_ids == ref.token_ids
 
-    # pp in the mesh splits the pool's layer dim: kernel must decline
+    # pp in the mesh is a validated serving rejection (VERDICT r5 #6):
+    # every decode round runs all layers as one program, so pipeline
+    # stages would idle 1/pp of each round — construction fails loudly
+    # at topology validation instead of serving degraded.
+    from generativeaiexamples_tpu.utils.errors import ConfigError
     mesh_pp = make_mesh(MeshPlan(pp=2, tp=2), jax.devices()[:4])
-    eng_pp = Engine(params, kcfg, tok, ecfg, mesh=mesh_pp)
-    assert not eng_pp._use_kernel
+    with pytest.raises(ConfigError, match=r"serving requires pp == 1"):
+        Engine(params, kcfg, tok, ecfg, mesh=mesh_pp)
 
 
 def test_engine_tp_mesh_chunked_long_prompt(cpu_devices):

@@ -1,0 +1,56 @@
+"""Smoke for tools/profile_decode.py --json: the roofline-attribution
+artifact (PROFILE_rNN.json round record) must be written with a stable
+key set, on any backend — the driver diffs these fields round over
+round, so a rename here is as breaking as a bench-field rename."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+
+REQUIRED_KEYS = {
+    "tool", "model", "device", "platform", "quant", "kv_quant",
+    "slots", "window_pages", "live_pages", "steps_per_round", "page_size",
+    "param_gb", "kv_live_bytes",
+    "full_ms_per_step", "no_unembed_ms_per_step", "window1_ms_per_step",
+    "unembed_ms_per_step", "window_stream_ms_per_step",
+    "matmul_floor_ms_per_step", "tokens_per_sec",
+}
+
+
+def test_profile_decode_json_artifact(tmp_path, monkeypatch):
+    import profile_decode
+
+    monkeypatch.setenv("PROF_MODEL", "llama-tiny")
+    monkeypatch.setenv("PROF_QUANT", "none")
+    monkeypatch.setenv("PROF_SLOTS", "2")
+    monkeypatch.setenv("PROF_WINDOW", "2")
+    monkeypatch.setenv("PROF_STEPS", "4")
+    path = str(tmp_path / "PROFILE_test.json")
+    artifact = profile_decode.main(json_path=path)
+    assert os.path.exists(path)
+    with open(path) as f:
+        on_disk = json.load(f)
+    assert on_disk == artifact
+    assert set(on_disk) == REQUIRED_KEYS
+    assert on_disk["tool"] == "profile_decode"
+    assert on_disk["full_ms_per_step"] > 0
+    # attribution decomposes the full round: ablations can't be slower
+    # than the full program by more than noise
+    assert on_disk["unembed_ms_per_step"] > -1.0
+    assert on_disk["window_stream_ms_per_step"] > -1.0
+
+
+def test_committed_round_artifact_is_valid():
+    """The committed PROFILE_rNN.json next to BENCH parses and carries
+    the same contract (whatever round number is current)."""
+    import glob
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    artifacts = sorted(glob.glob(os.path.join(root, "PROFILE_r*.json")))
+    assert artifacts, "no committed PROFILE_rNN.json round artifact"
+    with open(artifacts[-1]) as f:
+        obj = json.load(f)
+    assert set(obj) == REQUIRED_KEYS

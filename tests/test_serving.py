@@ -23,12 +23,21 @@ def test_resolve_topology_defaults():
     # tp defaults to world/pp; TPxPP must equal world
     # (reference: model_server/__init__.py:103-110)
     assert resolve_topology(available=8) == (8, 8, 1)
-    assert resolve_topology(pp=2, available=8) == (8, 4, 2)
-    assert resolve_topology(world_size=4, tp=2, pp=2, available=8) == (4, 2, 2)
-    with pytest.raises(ConfigError):
-        resolve_topology(world_size=8, tp=3, pp=2, available=8)
+    assert resolve_topology(world_size=4, tp=4, available=8) == (4, 4, 1)
     with pytest.raises(ConfigError):
         resolve_topology(world_size=16, available=8)
+
+
+def test_resolve_topology_rejects_pp_serving():
+    """pp>1 serving is a validated rejection (VERDICT r5 #6): decode
+    dispatches all layers as one program per round, so pipeline stages
+    would idle 1/pp of each round. Must fail at topology resolution —
+    milliseconds into startup, before checkpoint conversion — with the
+    documented message."""
+    with pytest.raises(ConfigError, match=r"serving requires pp == 1"):
+        resolve_topology(pp=2, available=8)
+    with pytest.raises(ConfigError, match=r"training-only"):
+        resolve_topology(world_size=4, tp=2, pp=2, available=8)
 
 
 def test_fast_hash_dir_changes_with_content(tmp_path):

@@ -1,0 +1,119 @@
+"""Validate a bench.py result (or a committed BENCH_rNN.json round
+artifact) against the checked-in key schema.
+
+The bench JSON line IS the perf trajectory: the driver diffs one round's
+fields against the last, so a silent rename (``loop_hround`` →
+``engine_harvest_wait``, ``e2e_chat_p99_ttft_ms`` → anything) breaks the
+comparison without breaking the bench. Two enforcement points share this
+module:
+
+- ``bench.py`` validates its own result before printing — a drifting
+  field aborts the bench run on the chip with a precise message;
+- ``tests/test_bench_schema.py`` validates a fully-populated synthetic
+  result assembled by ``bench.assemble_result`` in the tier-1 suite —
+  renames fail fast on CPU, before any chip time is spent.
+
+CLI: ``python tools/check_bench_schema.py BENCH_r06.json [...]``
+(accepts either the raw result object or the driver's artifact wrapper
+with a ``parsed`` sub-object).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+SCHEMA_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "bench_schema.json")
+
+_TYPES = {
+    "str": lambda v: isinstance(v, str),
+    # bool is an int subclass: exclude it from the numeric kinds so a
+    # True never masquerades as a measurement
+    "num": lambda v: isinstance(v, (int, float))
+    and not isinstance(v, bool),
+    "int": lambda v: isinstance(v, int) and not isinstance(v, bool),
+    "bool": lambda v: isinstance(v, bool),
+    "obj": lambda v: isinstance(v, dict),
+    "list": lambda v: isinstance(v, list),
+    "null": lambda v: v is None,
+}
+
+
+class BenchSchemaError(ValueError):
+    """A bench result does not match the checked-in key schema."""
+
+
+def load_schema(path: str = SCHEMA_PATH) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def _check_types(section: str, obj: dict, spec: dict,
+                 errors: list) -> None:
+    for key, kinds in spec.items():
+        if key not in obj:
+            errors.append(f"{section}: missing required key {key!r}")
+            continue
+        value = obj[key]
+        if not any(_TYPES[kind](value) for kind in kinds):
+            errors.append(
+                f"{section}.{key}: value {value!r} is not any of "
+                f"{'/'.join(kinds)}")
+    unknown = sorted(set(obj) - set(spec))
+    if unknown:
+        errors.append(
+            f"{section}: unknown key(s) {unknown} — new fields must be "
+            f"added to tools/bench_schema.json (renames break the "
+            f"round-over-round perf comparison)")
+
+
+def validate_result(result: dict, schema: dict | None = None) -> None:
+    """Raise BenchSchemaError listing every mismatch between ``result``
+    and the schema; returns silently on a clean result."""
+    schema = schema or load_schema()
+    errors: list[str] = []
+    _check_types("result", result, schema["top_level"], errors)
+    for section in ("engine_pipeline", "e2e_ttft_dist_ms", "chat"):
+        sub = result.get(section)
+        if isinstance(sub, dict):
+            _check_types(section, sub, schema[section], errors)
+    breakdown = result.get("e2e_breakdown_ms")
+    if isinstance(breakdown, dict):
+        allowed = set(schema["breakdown_stages"])
+        unknown = sorted(set(breakdown) - allowed)
+        if unknown:
+            errors.append(
+                f"e2e_breakdown_ms: unknown stage(s) {unknown} — stage "
+                f"renames must update breakdown_stages in "
+                f"tools/bench_schema.json")
+        for key, value in breakdown.items():
+            if not _TYPES["num"](value):
+                errors.append(
+                    f"e2e_breakdown_ms.{key}: {value!r} is not numeric")
+    if errors:
+        raise BenchSchemaError("; ".join(errors))
+
+
+def main(argv: list[str]) -> int:
+    if not argv:
+        print(__doc__)
+        return 2
+    schema = load_schema()
+    rc = 0
+    for path in argv:
+        with open(path) as f:
+            obj = json.load(f)
+        result = obj.get("parsed", obj)  # driver artifact wrapper or raw
+        try:
+            validate_result(result, schema)
+            print(f"{path}: ok")
+        except BenchSchemaError as exc:
+            print(f"{path}: FAIL — {exc}")
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -4,10 +4,18 @@ Times a jitted 16-step decode round (the engine's actual dispatch unit)
 and ablations of it — per-dispatch tunnel latency here is ~4-5 ms, so
 only multi-step fused programs give honest per-step numbers.
 Run on TPU: python tools/profile_decode.py
+
+``--json PATH`` additionally writes the roofline attribution (unembed /
+KV window stream / weight-stream floor, ms per step) as a machine-
+readable artifact — committed each round as ``PROFILE_rNN.json`` next
+to BENCH so perf attribution is driver-verifiable rather than narrated
+(VERDICT r5 "Next round" #8).
 """
 
 from __future__ import annotations
 
+import argparse
+import json
 import os
 import sys
 import time
@@ -19,7 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def main():
+def main(json_path: str = ""):
     from generativeaiexamples_tpu.models import llama
     from generativeaiexamples_tpu.models.configs import get_model_config
     from generativeaiexamples_tpu.ops.quant import quantize_params
@@ -103,10 +111,44 @@ def main():
     nou = run("no unembed   ", make_round("no_unembed"), kv_live)
     w1 = run("window=1     ", make_round("window1"),
              kv_live // max(live_pages, 1))
+    floor = param_bytes / 819e9 * 1e3
     print(f"=> unembed+argmax ~{full-nou:.2f} ms/step, "
           f"window stream ~{full-w1:.2f} ms/step, "
-          f"matmul floor {param_bytes/819e9*1e3:.2f} ms/step @819GB/s")
+          f"matmul floor {floor:.2f} ms/step @819GB/s")
+
+    if json_path:
+        # Roofline attribution as a committed round artifact: the same
+        # shape every round, so the driver diffs attribution (did the
+        # window stream shrink? did unembed grow?) not just the headline.
+        artifact = {
+            "tool": "profile_decode",
+            "model": model,
+            "device": str(jax.local_devices()[0].device_kind),
+            "platform": jax.default_backend(),
+            "quant": quant,
+            "kv_quant": "int8" if kv_quant else "",
+            "slots": B, "window_pages": W, "live_pages": live_pages,
+            "steps_per_round": K, "page_size": page,
+            "param_gb": round(param_bytes / 1e9, 3),
+            "kv_live_bytes": kv_live,
+            "full_ms_per_step": round(full, 3),
+            "no_unembed_ms_per_step": round(nou, 3),
+            "window1_ms_per_step": round(w1, 3),
+            "unembed_ms_per_step": round(full - nou, 3),
+            "window_stream_ms_per_step": round(full - w1, 3),
+            "matmul_floor_ms_per_step": round(floor, 3),
+            "tokens_per_sec": round(B / full * 1e3, 1),
+        }
+        with open(json_path, "w") as f:
+            json.dump(artifact, f, indent=2)
+            f.write("\n")
+        print(f"wrote {json_path}")
+        return artifact
 
 
 if __name__ == "__main__":
-    main()
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--json", default="", metavar="PATH",
+                    help="also write the roofline attribution as a JSON "
+                         "artifact (PROFILE_rNN.json round record)")
+    main(json_path=ap.parse_args().json)
