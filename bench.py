@@ -370,7 +370,7 @@ def assemble_result(*, kind, model, headline, engine_p50, engine_p99, tput,
                     e2e_dist, e2e_breakdown, pipeline, quant, kv_quant,
                     weights, prompt_len, out_len, slots, steps_per_round,
                     kv_pool_pages, device, rtt_ms, n_devices,
-                    bench_seconds) -> dict:
+                    bench_seconds, e2e_tps_p50=None) -> dict:
     """The bench's single output contract. Every field name here is
     pinned by tools/bench_schema.json (validated at emit time AND by the
     tier-1 suite, tests/test_bench_schema.py) so a rename fails fast
@@ -394,6 +394,12 @@ def assemble_result(*, kind, model, headline, engine_p50, engine_p99, tput,
         "e2e_chat_p99_ttft_ms": e2e_dist["p99"] if e2e_dist else None,
         "e2e_ttft_dist_ms": e2e_dist,
         "e2e_breakdown_ms": e2e_breakdown,
+        # Exact median of per-request tokens/sec (flight-timeline
+        # generated/duration, warmup excluded) — the per-request
+        # distribution the old last-write-wins gauge could not represent
+        # under concurrency; live scrapes get the same distribution as
+        # the chain_generate_tokens_per_second histogram
+        "e2e_tokens_per_second_p50": e2e_tps_p50,
         # Harvest/dispatch overlap: the readback wait now runs on the
         # harvest worker, concurrent with dispatch (pipeline_snapshot)
         "engine_pipeline": pipeline,
@@ -451,9 +457,19 @@ def hbm_utilization(engine, model_cfg, tput: float, slots: int,
 def run_e2e_bench(engine, embedder, n_requests: int):
     """p50 TTFT of the full QA-chatbot path through the chain server,
     plus a per-stage latency breakdown (embed / retrieve / template /
-    prefill / first chunk) collected via the obs stage hook."""
+    prefill / first chunk) read from each request's FLIGHT-RECORDER
+    timeline (obs/flight.py): the bench sends an X-Request-ID per
+    request and looks its completed timeline up afterwards — the same
+    path an operator debugging one slow production request takes via
+    /debug/requests, so the bench exercises (and validates) the
+    recorder itself instead of the former process-global
+    set_stage_collector hook. Process-GLOBAL pipeline stages
+    (harvest wait per round, loop phases) are not per-request facts and
+    therefore no longer appear in this breakdown — they live in the
+    artifact's ``engine_pipeline`` block (pipeline_snapshot)."""
     import statistics
     import tempfile
+    import uuid
 
     import requests
     from aiohttp import web
@@ -461,7 +477,7 @@ def run_e2e_bench(engine, embedder, n_requests: int):
     from generativeaiexamples_tpu.chains.examples.developer_rag import QAChatbot
     from generativeaiexamples_tpu.chains.llm import EngineLLM
     from generativeaiexamples_tpu.chains.server import create_app
-    from generativeaiexamples_tpu.obs.tracing import set_stage_collector
+    from generativeaiexamples_tpu.obs import flight
     from generativeaiexamples_tpu.utils.app_config import AppConfig
     from generativeaiexamples_tpu.utils.configuration import from_dict
 
@@ -508,9 +524,8 @@ def run_e2e_bench(engine, embedder, n_requests: int):
     started.wait(timeout=30)
     url = f"http://127.0.0.1:{port_holder['port']}/generate"
 
-    stages: dict = {}
     all_stages: list = []
-    set_stage_collector(lambda name, dt: stages.setdefault(name, dt))
+    raw_tps: list = []
 
     def one_ttft(seq: int) -> float:
         # num_tokens bounds the overestimate: with random weights the
@@ -526,12 +541,13 @@ def run_e2e_bench(engine, embedder, n_requests: int):
         # scenario's job). The shared system/context prefix still
         # matching is the production-realistic part and is reported by
         # the engine's hit counters, not hidden.
-        stages.clear()
+        rid = f"bench-{seq}-{uuid.uuid4().hex[:8]}"
         t0 = time.monotonic()
         with requests.post(url, json={
                 "question": f"(case {seq}) What does the MXU do and "
                             f"how big is it?",
                 "use_knowledge_base": True, "num_tokens": 16},
+                headers={"X-Request-ID": rid},
                 stream=True, timeout=300) as resp:
             resp.raise_for_status()
             # First byte, or EOF for a zero-visible-token generation
@@ -558,13 +574,30 @@ def run_e2e_bench(engine, embedder, n_requests: int):
             if b"[error]" in tail:
                 raise RuntimeError(
                     f"e2e generation failed in-stream: {tail[:200]!r}")
-        all_stages.append(dict(stages))
+        # The per-stage breakdown comes from this request's flight
+        # timeline — chain stages (embedding/retrieve/templating/llm)
+        # and engine stages (admit/first readback/ttft) on one record,
+        # keyed by the X-Request-ID sent above. The timeline's
+        # generated/duration also give the request's TRUE tokens/sec
+        # (exact, unlike the bucket-edge-quantized histogram p50, and
+        # warmup-free since the warmup's rid is never looked up here).
+        tl = flight.RECORDER.find(rid)
+        # The chain worker's finally completes the timeline (stamping
+        # duration_ms) moments after the HTTP body drains — wait for it.
+        deadline = time.monotonic() + 5
+        while tl is not None and not tl.done \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        all_stages.append(tl.stage_durations() if tl is not None else {})
+        meta = tl.meta if tl is not None else {}
+        if meta.get("generated") and meta.get("duration_ms"):
+            raw_tps.append(meta["generated"] / (meta["duration_ms"] / 1e3))
         return dt
 
     one_ttft(seq=0)  # warmup: compiles the e2e prompt geometry
     all_stages.clear()
+    raw_tps.clear()
     raw = [one_ttft(seq=1 + i) for i in range(n_requests)]
-    set_stage_collector(None)
     loop.call_soon_threadsafe(loop.stop)
     ttfts = sorted(raw)
     p50 = ttfts[len(ttfts) // 2]
@@ -584,7 +617,8 @@ def run_e2e_bench(engine, embedder, n_requests: int):
         vals = [s[key] * 1e3 for s in all_stages if key in s]
         if vals:
             breakdown[key] = round(statistics.median(vals), 2)
-    return p50, dist, breakdown
+    tps_p50 = round(statistics.median(raw_tps), 1) if raw_tps else None
+    return p50, dist, breakdown, tps_p50
 
 
 def main() -> None:
@@ -704,10 +738,11 @@ def main() -> None:
             except Exception as exc:  # noqa: BLE001
                 sys.stderr.write(f"bench: chat scenario failed: {exc}\n")
         e2e_p50, e2e_dist, e2e_breakdown = None, None, None
+        e2e_tps_p50 = None
         if not skip_e2e:
             try:
-                e2e_p50, e2e_dist, e2e_breakdown = run_e2e_bench(
-                    engine, embedder, max(3, n_requests))
+                e2e_p50, e2e_dist, e2e_breakdown, e2e_tps_p50 = \
+                    run_e2e_bench(engine, embedder, max(3, n_requests))
             except Exception as exc:  # noqa: BLE001
                 sys.stderr.write(f"bench: e2e failed: {exc}\n")
         # Cumulative over every scenario above — the overlap summary is
@@ -727,7 +762,8 @@ def main() -> None:
         engine_p50=p50, engine_p99=p99, tput=tput,
         achieved_bw=achieved_bw, bw_util=bw_util, bw_steady=bw_steady,
         chat=chat, e2e_p50=e2e_p50, e2e_dist=e2e_dist,
-        e2e_breakdown=e2e_breakdown, pipeline=pipeline,
+        e2e_breakdown=e2e_breakdown, e2e_tps_p50=e2e_tps_p50,
+        pipeline=pipeline,
         quant=quant, kv_quant=engine.cfg.kv_quant or None,
         weights=("real" if os.environ.get("BENCH_MODEL_PATH")
                  else "random-init"),

@@ -4,12 +4,23 @@ Exact parity with the reference's ABC (reference: common/base.py:21-33):
 ``llm_chain`` / ``rag_chain`` stream answer text, ``ingest_docs`` loads a
 file into the knowledge base; ``document_search`` is optional and duck-typed
 by the server (reference: common/server.py:152).
+
+Request identity: examples do NOT thread a request ID through these
+signatures. The chain server binds the inbound request's flight-recorder
+timeline (adopted ``X-Request-ID``/traceparent, ``obs/flight.py``) on the
+context the chain generator runs under, so anything an example calls —
+``event_span`` stages, the embedder, ``Engine.submit`` via EngineLLM —
+lands on the right per-request timeline automatically. An example that
+wants the ID (e.g. to tag its own logs) reads
+``current_request_id()`` below.
 """
 
 from __future__ import annotations
 
 import abc
 from typing import Any, Generator
+
+from ..obs.flight import current_request_id  # noqa: F401  (re-export)
 
 
 class BaseExample(abc.ABC):

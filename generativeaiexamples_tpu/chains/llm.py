@@ -123,12 +123,12 @@ class EngineLLM(LLM):
         try:
             for chunk in stream:
                 if first:
-                    # stage-breakdown hooks: time to the first visible
-                    # chunk (includes tokenize+queue+prefill+detok) and
-                    # the engine's own submit->first-token clock
+                    # stage-breakdown hook: time to the first visible
+                    # chunk (includes tokenize+queue+prefill+detok).
+                    # engine_ttft is NOT re-reported here — the engine
+                    # records the authoritative one at first-token
+                    # harvest (engine.py _emit_token).
                     record_stage("llm_first_chunk", time.monotonic() - t0)
-                    if stream.ttft_ms is not None:
-                        record_stage("engine_ttft", stream.ttft_ms / 1e3)
                     if on_sources is not None and stream.source_ids:
                         on_sources(stream.source_ids)
                     first = False

@@ -30,6 +30,7 @@ from typing import Optional
 
 from aiohttp import web
 
+from ..obs import flight as obs_flight
 from ..obs import metrics as obs_metrics
 from ..obs.tracing import instrumented
 from ..serving.streaming import iterate_in_thread
@@ -105,14 +106,39 @@ def create_app(example: BaseExample,
         if not question:
             raise web.HTTPUnprocessableEntity(text="'question' is required")
 
+        # Flight recorder: adopt the caller's X-Request-ID (or W3C
+        # trace-id) — this ID names the request's timeline in
+        # /debug/requests, the engine's stream, and the slow-request
+        # dump. Echoed back so callers can correlate without sending one.
+        rid = obs_flight.adopt_request_id(request.headers)
+        # fresh: a retry racing its original under the same client ID
+        # gets its own (#N-suffixed) timeline, never the original's.
+        timeline = obs_flight.RECORDER.begin(rid, fresh=True)
+        rid = timeline.request_id
+        timeline.annotate(route="/generate", use_kb=use_kb,
+                          num_tokens=num_tokens)
+
         resp = web.StreamResponse(
             headers={"Content-Type": "text/event-stream",
-                     "Cache-Control": "no-cache"})
-        await resp.prepare(request)
+                     "Cache-Control": "no-cache",
+                     "X-Request-ID": rid})
+        try:
+            await resp.prepare(request)
+        except BaseException:
+            # Client vanished before headers went out: run_chain (whose
+            # finally completes the timeline) never starts — retire it
+            # here or it would sit in the in-flight map forever.
+            timeline.annotate(finish="disconnected")
+            obs_flight.RECORDER.complete(timeline)
+            raise
 
         def run_chain():
             """Generator wrapping the chain: per-token metrics + degrade to
-            a user-readable error in-stream (reference: server.py:136-142)."""
+            a user-readable error in-stream (reference: server.py:136-142).
+            Runs on a worker thread under the request's copied context
+            (iterate_in_thread), so the timeline bound here is visible to
+            every stage below it — including Engine.submit."""
+            token = obs_flight.bind(timeline)
             timer = obs_metrics.RequestTimer("chain_generate")
             try:
                 gen = (example.rag_chain(question, num_tokens) if use_kb
@@ -120,11 +146,23 @@ def create_app(example: BaseExample,
                 for chunk in gen:
                     timer.token(1)
                     yield chunk
+            except GeneratorExit:
+                # Consumer abandoned the stream (client disconnect):
+                # record the truth — this request did NOT complete.
+                timeline.meta.setdefault("finish", "disconnected")
+                raise
             except Exception as exc:  # noqa: BLE001
                 logger.exception("generation failed")
+                timeline.annotate(finish="error", error=str(exc))
                 yield f"\n[error] {exc}"
             finally:
                 timer.finish()
+                obs_flight.unbind(token)
+                # Engine-served requests were already completed at the
+                # stream's terminal transition (complete() is idempotent);
+                # this covers chains that never reach an engine.
+                timeline.meta.setdefault("finish", "done")
+                obs_flight.RECORDER.complete(timeline)
 
         try:
             async for chunk in iterate_in_thread(run_chain()):
@@ -161,8 +199,14 @@ def create_app(example: BaseExample,
         return web.Response(text=obs_metrics.REGISTRY.render_prometheus(),
                             content_type="text/plain")
 
+    async def debug_requests(request: web.Request) -> web.Response:
+        # Per-request flight recorder: in-flight + last-N completed
+        # timelines (obs/flight.py; ?limit= caps the completed list).
+        return obs_flight.debug_requests_response(request)
+
     app.router.add_get("/health", health)
     app.router.add_get("/metrics", metrics_endpoint)
+    app.router.add_get("/debug/requests", debug_requests)
     app.router.add_post("/uploadDocument", upload_document)
     app.router.add_post("/generate", generate_answer)
     app.router.add_post("/documentSearch", document_search)
@@ -180,6 +224,20 @@ def main(argv: Optional[list[str]] = None) -> None:
     parser.add_argument("--port", type=int, default=8081)
     parser.add_argument("--upload-dir", default="./uploaded_files")
     args = parser.parse_args(argv)
+
+    # Config-file tracing switch: tracing.enabled in the app config turns
+    # the OTel spine on without the ENABLE_TRACING env var (set_enabled
+    # re-evaluates at call time — no module reimport needed).
+    try:
+        from ..obs import tracing as obs_tracing
+        from ..utils.app_config import get_config
+        tcfg = get_config().tracing
+        if tcfg.enabled and not obs_tracing.enabled():
+            os.environ.setdefault("OTEL_EXPORTER_OTLP_ENDPOINT",
+                                  tcfg.otlp_endpoint)
+            obs_tracing.set_enabled(True)
+    except Exception:  # noqa: BLE001 — config problems must not kill boot
+        logger.debug("tracing config not applied", exc_info=True)
 
     example_cls = discover_example(args.example)
     example = example_cls()

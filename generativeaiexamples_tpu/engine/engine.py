@@ -58,6 +58,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..models import llama
 from ..models.configs import LlamaConfig
 from ..models.tokenizer import Tokenizer
+from ..obs import flight as obs_flight
+from ..obs.tracing import record_stage
 from ..ops.sampling import apply_repetition_penalty, sample, seen_mask
 from ..parallel.sharding import (llama_param_specs, paged_kv_cache_spec,
                                  shard_params)
@@ -69,6 +71,36 @@ from .sampling_params import SamplingParams
 
 def _ceil_div(a: int, b: int) -> int:
     return -(-a // b)
+
+
+# Engine-owned cumulative counters, the keys ``stats()`` always carries.
+# A TEMPLATE (each Engine copies it) so tools/check_metrics_docs.py can
+# enumerate the stats surface without building an engine — the tier-1
+# guard that keeps docs/observability.md's gauge table and stats() from
+# drifting apart.
+_STATS_TEMPLATE = {
+    "requests": 0, "tokens_generated": 0, "decode_steps": 0, "prefills": 0,
+    # Pipeline stage counters (cumulative ms + event counts): how long
+    # the harvest worker blocked on round/first readbacks — time that
+    # overlaps dispatch instead of serializing the loop.
+    "harvest_wait_ms": 0.0, "harvest_rounds": 0,
+    "first_readback_ms": 0.0, "first_readbacks": 0,
+    # Monotonic high-water mark of the device queue (rounds dispatched
+    # ahead of harvest): the live gauge reads 0 whenever the engine is
+    # idle, so artifacts sampled after a run need the peak to show the
+    # overlap actually happened.
+    "dispatch_depth_peak": 0,
+}
+
+
+def engine_stat_keys() -> tuple[str, ...]:
+    """Every key an ``Engine.stats`` snapshot can contain: the cumulative
+    template above, the read-time pipeline gauge, and the prefix-cache
+    counters (prefix caching is on by default). The single source of
+    truth tools/check_metrics_docs.py checks the docs against."""
+    from .prefix_cache import CacheStats
+    return (tuple(_STATS_TEMPLATE) + ("dispatch_queue_depth",)
+            + tuple(CacheStats().snapshot()) + ("prefix_cache_pages",))
 
 
 def _layout_api():
@@ -186,10 +218,26 @@ class EngineConfig:
 
 
 class TokenStream:
-    """Thread-safe stream of text chunks for one request."""
+    """Thread-safe stream of text chunks for one request.
 
-    def __init__(self, request_id: int):
+    ``request_id`` is the END-TO-END identity: the string minted (or
+    adopted from ``X-Request-ID``/W3C traceparent) at the serving edge
+    and stamped here by ``Engine.submit`` — the same ID names this
+    request's flight-recorder timeline (``/debug/requests``), its
+    slow-request log dump, and its replayed engine-stage spans.
+    """
+
+    def __init__(self, request_id: str):
         self.request_id = request_id
+        # Flight-recorder hookup (set by Engine.submit): the timeline
+        # this request's events land on, and the recorder that retires
+        # it at the terminal transition below. owns_timeline is False
+        # when the timeline was ADOPTED from a serving edge (the edge
+        # completes it; this stream only contributes sub-call stats —
+        # agent chains run several engine calls per request).
+        self.timeline: Optional[obs_flight.Timeline] = None
+        self.owns_timeline = True
+        self._flight: Optional[obs_flight.FlightRecorder] = None
         self._q: "queue.Queue[tuple[str, object]]" = queue.Queue()
         self._error: Optional[BaseException] = None
         self.finish_reason: Optional[str] = None
@@ -206,14 +254,29 @@ class TokenStream:
         if text:
             self._q.put(("chunk", text))
 
+    def _record_done(self) -> None:
+        """Retire the timeline on the FIRST terminal transition — every
+        finish path (harvest finish, drain, fatal fan-out, reset) funnels
+        through _finish/_fail, so no request can leak in /debug/requests'
+        in-flight view. Idempotent via the recorder."""
+        if self._flight is not None:
+            self._flight.complete_stream(self)
+
     def _finish(self, reason: str) -> None:
         self.finish_reason = reason
         self.finish_time = time.monotonic()
+        # Record into the timeline BEFORE the terminal sentinel goes
+        # out: once the sentinel is consumed, the chain server's finally
+        # races to complete() the timeline, and losing that race would
+        # drop this stream's generated/ttft/finish annotations
+        # (complete() is first-wins).
+        self._record_done()
         self._q.put(("done", reason))
 
     def _fail(self, exc: BaseException) -> None:
         self._error = exc   # sticky: re-iteration re-raises, never hangs
         self.finish_reason = "error"
+        self._record_done()  # before the sentinel — see _finish
         self._q.put(("error", exc))
 
     def cancel(self) -> None:
@@ -411,7 +474,11 @@ class Engine:
         self._state = self._init_device_state()
         self._base_key = jax.random.key(cfg.seed)
         self._step_counter = itertools.count()
-        self._req_counter = itertools.count()
+        # Flight recorder override for per-request timelines (None = the
+        # process-global obs_flight.RECORDER, resolved at USE time so a
+        # swapped global never splits one request across two recorders);
+        # tests install a private instance via the `flight` setter.
+        self._flight_override: Optional[obs_flight.FlightRecorder] = None
 
         self._fused_rag = None           # set by enable_fused_rag()
         self._rag_jit = None
@@ -444,20 +511,7 @@ class Engine:
         self._gen = 0
 
         self._stats_lock = threading.Lock()
-        self._stats = {"requests": 0, "tokens_generated": 0,
-                       "decode_steps": 0, "prefills": 0,
-                       # Pipeline stage counters (cumulative ms + event
-                       # counts): how long the harvest worker blocked on
-                       # round/first readbacks — time that now overlaps
-                       # dispatch instead of serializing the loop.
-                       "harvest_wait_ms": 0.0, "harvest_rounds": 0,
-                       "first_readback_ms": 0.0, "first_readbacks": 0,
-                       # Monotonic high-water mark of the device queue
-                       # (rounds dispatched ahead of harvest): the live
-                       # gauge reads 0 whenever the engine is idle, so
-                       # artifacts sampled after a run need the peak to
-                       # show the overlap actually happened.
-                       "dispatch_depth_peak": 0}
+        self._stats = dict(_STATS_TEMPLATE)  # keys doc-checked, see above
         # Decode-attention page windows: power-of-two ladder up to the max.
         ladder = []
         w = 1
@@ -800,7 +854,8 @@ class Engine:
             stream = self.submit(ids, _SP(
                 max_tokens=min(self.cfg.max_output_length,
                                2 * self.cfg.steps_per_round + 1),
-                top_k=1, ignore_eos=True))
+                top_k=1, ignore_eos=True),
+                request_id="engine-prewarm")  # recognizable in /debug
             try:
                 for _ in stream:
                     pass
@@ -819,6 +874,17 @@ class Engine:
         # Scrub the dummy from served stats.
         with self._stats_lock:
             self._stats["requests"] -= 1
+
+    @property
+    def flight(self) -> obs_flight.FlightRecorder:
+        """Flight recorder in use: the process-global one unless a
+        private instance was installed (tests). Resolved per access so
+        the engine and the HTTP servers always agree on the recorder."""
+        return self._flight_override or obs_flight.RECORDER
+
+    @flight.setter
+    def flight(self, recorder: obs_flight.FlightRecorder) -> None:
+        self._flight_override = recorder
 
     @property
     def stats(self) -> dict[str, float]:
@@ -1239,7 +1305,9 @@ class Engine:
             + [0] * (n_chunks * C - suffix)
         seed_arr = None if seen0 is None else jnp.asarray(seen0)
         first_tok = None
+        tl = req.stream.timeline
         for i in range(n_chunks):
+            t_chunk = time.monotonic()
             toks = jnp.asarray(np.asarray(
                 padded[i * C:(i + 1) * C], np.int32)[None, :])
             start = jnp.int32(start_tok + i * C)
@@ -1271,6 +1339,11 @@ class Engine:
                     window, req.greedy, seeding)(*args)
             self._guard_live()
             self._state = new_state
+            if tl is not None:
+                # Host-side dispatch time of this chunk (the device work
+                # is async); one event per chunk, i == the chunk index.
+                tl.stage("engine_prefill_chunk",
+                         time.monotonic() - t_chunk)
         return first_tok
 
     # ------------------------------------------------------------- lifecycle
@@ -1572,9 +1645,40 @@ class Engine:
             raise EngineError("enable_fused_rag() first")
         self._fused_rag.set_corpus(emb, toks, lens)
 
+    def _new_stream(self, request_id: Optional[str],
+                    prompt_tokens: int, eff_max: int) -> TokenStream:
+        """TokenStream + flight timeline for one submission. The request
+        ID resolves in priority order: explicit argument, the ID bound on
+        the calling context (the chain server's adopted X-Request-ID,
+        visible here because the chain generator runs under a copied
+        context), else a freshly minted one."""
+        tl_ctx = obs_flight.current()
+        if request_id is None and tl_ctx is not None:
+            # The serving edge already opened this request's timeline —
+            # pair by OBJECT identity (not by re-looking-up the rid,
+            # which could collide with an unrelated in-flight request
+            # reusing the same client-supplied ID). The edge owns its
+            # completion; this stream only contributes sub-call stats.
+            tl = tl_ctx
+            owns = False
+        else:
+            # Direct submission (OpenAI surface, tests, prewarm): every
+            # call is a new request — fresh disambiguates duplicate IDs.
+            tl = self.flight.begin(
+                request_id or obs_flight.mint_request_id(), fresh=True)
+            owns = True
+        stream = TokenStream(tl.request_id)
+        stream.owns_timeline = owns
+        tl.annotate(prompt_tokens=prompt_tokens, max_tokens=eff_max)
+        tl.event("engine_submit")
+        stream.timeline = tl
+        stream._flight = self.flight
+        return stream
+
     def submit_rag(self, question_ids: Sequence[int],
                    question_enc_ids: Sequence[int],
-                   params: Optional[SamplingParams] = None) -> TokenStream:
+                   params: Optional[SamplingParams] = None,
+                   request_id: Optional[str] = None) -> TokenStream:
         """Enqueue a fused-RAG request: retrieval and prompt assembly
         happen on-device during admission; ``question_ids`` are the
         question's tokens in the LLM vocab (no BOS), ``question_enc_ids``
@@ -1613,7 +1717,7 @@ class Engine:
         banned_ids, bad_seqs = self._compile_bad_words(params)
         banned_np, bad_seq_np, bad_len_np = self._render_bad_words(
             banned_ids, bad_seqs)
-        stream = TokenStream(next(self._req_counter))
+        stream = self._new_stream(request_id, len(ids), eff_max)
         req = _Request(stream=stream, prompt_ids=[], params=params,
                        eff_max=eff_max, extent=spec.bucket + eff_max,
                        detok=IncrementalDetokenizer(self.tokenizer),
@@ -1626,6 +1730,11 @@ class Engine:
         try:
             self._pending.put_nowait((req, params))
         except queue.Full:
+            # Retire the timeline (reason recorded): rejected admissions
+            # show up in /debug/requests instead of leaking as forever-
+            # in-flight entries.
+            stream.timeline.annotate(finish="rejected")
+            self.flight.complete(stream.timeline)
             raise SchedulerFullError(
                 f"request queue full ({self.cfg.max_queue})") from None
         if self._fatal is not None:
@@ -1635,8 +1744,15 @@ class Engine:
         return stream
 
     def submit(self, prompt_ids: Sequence[int],
-               params: Optional[SamplingParams] = None) -> TokenStream:
-        """Enqueue a request; returns its stream immediately."""
+               params: Optional[SamplingParams] = None,
+               request_id: Optional[str] = None) -> TokenStream:
+        """Enqueue a request; returns its stream immediately.
+
+        ``request_id``: the end-to-end request identity (see
+        TokenStream). Omitted, it is adopted from the calling context
+        (obs/flight.py contextvar — how the chain server's
+        ``X-Request-ID`` reaches the engine without threading a parameter
+        through every BaseExample chain) or minted fresh."""
         if self._fatal is not None:
             raise EngineError("engine is dead") from self._fatal
         params = params or SamplingParams()
@@ -1656,7 +1772,7 @@ class Engine:
         banned_ids, bad_seqs = self._compile_bad_words(params)
         banned_np, bad_seq_np, bad_len_np = self._render_bad_words(
             banned_ids, bad_seqs)
-        stream = TokenStream(next(self._req_counter))
+        stream = self._new_stream(request_id, len(prompt_ids), eff_max)
         req = _Request(stream=stream, prompt_ids=list(prompt_ids),
                        params=params, eff_max=eff_max,
                        extent=len(prompt_ids) + eff_max,
@@ -1669,6 +1785,11 @@ class Engine:
         try:
             self._pending.put_nowait((req, params))
         except queue.Full:
+            # Retire the timeline (reason recorded): rejected admissions
+            # show up in /debug/requests instead of leaking as forever-
+            # in-flight entries.
+            stream.timeline.annotate(finish="rejected")
+            self.flight.complete(stream.timeline)
             raise SchedulerFullError(
                 f"request queue full ({self.cfg.max_queue})") from None
         if self._fatal is not None:
@@ -1680,16 +1801,19 @@ class Engine:
         return stream
 
     def generate_text(self, prompt: str,
-                      params: Optional[SamplingParams] = None) -> str:
+                      params: Optional[SamplingParams] = None,
+                      request_id: Optional[str] = None) -> str:
         """Sync convenience: tokenize, generate, detokenize."""
         self.start()
         ids = self.tokenizer.encode(prompt)
-        return self.submit(ids, params).text()
+        return self.submit(ids, params, request_id=request_id).text()
 
     def stream_text(self, prompt: str,
-                    params: Optional[SamplingParams] = None) -> TokenStream:
+                    params: Optional[SamplingParams] = None,
+                    request_id: Optional[str] = None) -> TokenStream:
         self.start()
-        return self.submit(self.tokenizer.encode(prompt), params)
+        return self.submit(self.tokenizer.encode(prompt), params,
+                           request_id=request_id)
 
     # ------------------------------------------------------------ scheduler
 
@@ -1756,7 +1880,6 @@ class Engine:
         ~285 ms serialization). Idle iterations park on ``_wake``, which
         submit(), cancel-capable emission, and every harvested item set —
         a completion-signalled pipeline, not a poll."""
-        from ..obs.tracing import record_stage
         gen = self._gen
         try:
             while (not self._stopped.is_set() and self._gen == gen
@@ -1848,7 +1971,6 @@ class Engine:
         to ``_completed``. Execution errors surface at the readback on
         tunneled backends — they are caught here, recorded as _fatal, and
         fanned out by the scheduler."""
-        from ..obs.tracing import record_stage
         gen = self._gen
         try:
             while (not self._stopped.is_set() and self._gen == gen
@@ -1866,6 +1988,9 @@ class Engine:
                     record_stage("engine_first_readback", wait)
                     self._bump("first_readback_ms", wait * 1e3)
                     self._bump("first_readbacks")
+                    tl = req.stream.timeline
+                    if tl is not None:   # lock-free ring append
+                        tl.stage("engine_first_readback", wait)
                     if self._gen != gen:
                         return
                     if not req.done:
@@ -1886,6 +2011,7 @@ class Engine:
                     self._bump("harvest_rounds")
                     if self._gen != gen:
                         return
+                    emitted: dict[int, int] = {}
                     for k in range(toks.shape[0]):
                         row = toks[k]
                         for slot, req in members.items():
@@ -1894,7 +2020,15 @@ class Engine:
                             tok = int(row[slot])
                             if tok < 0:
                                 continue  # inactive on-device at this step
+                            emitted[slot] = emitted.get(slot, 0) + 1
                             self._emit_token(req, tok)
+                    # ONE timeline event per request per round (token
+                    # count), never per token — the flight recorder's
+                    # token-path budget. Ring appends are lock-free.
+                    for slot, n in emitted.items():
+                        tl = members[slot].stream.timeline
+                        if tl is not None:
+                            tl.event("decode_round", n)
                     with self._pipe_lock:
                         # Guarded by the generation check just above: a
                         # worker disowned during the readback must not
@@ -1971,9 +2105,16 @@ class Engine:
                     st.hits += 1
                     st.hit_tokens += start_tok
 
-            from ..obs.tracing import record_stage
-            record_stage("engine_admit_pickup",
-                         time.monotonic() - req.stream.submit_time)
+            qwait = time.monotonic() - req.stream.submit_time
+            record_stage("engine_admit_pickup", qwait)
+            tl = req.stream.timeline
+            if tl is not None:
+                # Scheduler-side timeline events: queue wait, the slot
+                # and pages this request occupies, and how much of the
+                # prompt the prefix cache already held.
+                tl.stage("engine_admit_pickup", qwait)
+                tl.annotate(slot=slot, pages_held=len(req.pages),
+                            prefix_hit_tokens=start_tok)
             t_dispatch = time.monotonic()
             # Masks/tables were built at submit() on the caller's thread
             # (overlapped with the queue wait) — the serve loop only
@@ -2046,8 +2187,10 @@ class Engine:
             self._guard_live()
             self._state = new_state
             self._register_prefix(req, hashes, k_use)
-            record_stage("engine_admit_dispatch",
-                         time.monotonic() - t_dispatch)
+            admit_dt = time.monotonic() - t_dispatch
+            record_stage("engine_admit_dispatch", admit_dt)
+            if tl is not None:
+                tl.stage("engine_admit_dispatch", admit_dt)
             try:
                 # Start the device->host transfer of the first token now —
                 # the harvest worker's np.asarray then finds the value
@@ -2132,6 +2275,14 @@ class Engine:
         self._bump("tokens_generated")
         if req.stream.first_token_time is None:
             req.stream.first_token_time = time.monotonic()
+            ttft = req.stream.first_token_time - req.stream.submit_time
+            # Once per request, not per token. The single authoritative
+            # engine_ttft record: timeline + stage histogram/collector
+            # (EngineLLM deliberately does not re-report it).
+            record_stage("engine_ttft", ttft)
+            tl = req.stream.timeline
+            if tl is not None:
+                tl.stage("engine_ttft", ttft)
 
         finish: Optional[str] = None
         if token == self.tokenizer.eos_id and not req.params.ignore_eos:

@@ -4,7 +4,11 @@ Method-for-method parity with the reference's client (reference:
 frontend/frontend/chat_client.py): ``search`` (43), streaming ``predict``
 (72 — requests.post(stream=True), yields chunks then a ``None`` sentinel),
 ``upload_documents`` (101). Outgoing requests carry W3C trace context
-(reference: frontend/tracing.py:47-63).
+(reference: frontend/tracing.py:47-63) plus an ``X-Request-ID`` minted
+per call (or supplied by the caller) — the server adopts it as the
+request's flight-recorder identity, so a slow answer can be looked up in
+the chain server's ``/debug/requests`` by the ID this client holds in
+``last_request_id``.
 """
 
 from __future__ import annotations
@@ -13,6 +17,7 @@ from typing import Generator, Optional
 
 import requests
 
+from ..obs.flight import mint_request_id
 from ..obs.tracing import inject_context
 from ..utils.logging import get_logger
 
@@ -25,18 +30,28 @@ class ChatClient:
         self.server_url = server_url.rstrip("/")
         self.model_name = model_name
         self.timeout = timeout
+        # Request ID of the most recent call — what to quote when asking
+        # the chain server's /debug/requests why it was slow.
+        self.last_request_id: Optional[str] = None
 
-    def search(self, prompt: str, num_docs: int = 4) -> list[dict]:
+    def _headers(self, request_id: Optional[str] = None) -> dict:
+        rid = request_id or mint_request_id()
+        self.last_request_id = rid
+        return inject_context({"X-Request-ID": rid})
+
+    def search(self, prompt: str, num_docs: int = 4,
+               request_id: Optional[str] = None) -> list[dict]:
         """Document retrieval (reference: chat_client.py:43)."""
         resp = requests.post(
             f"{self.server_url}/documentSearch",
             json={"content": prompt, "num_docs": num_docs},
-            headers=inject_context({}), timeout=self.timeout)
+            headers=self._headers(request_id), timeout=self.timeout)
         resp.raise_for_status()
         return resp.json()
 
     def predict(self, query: str, use_knowledge_base: bool = True,
                 num_tokens: int = 256, context: str = "",
+                request_id: Optional[str] = None,
                 ) -> Generator[Optional[str], None, None]:
         """Stream answer chunks; yields ``None`` when the stream ends
         (reference: chat_client.py:72-99 — 16-byte chunk reads with a
@@ -48,7 +63,7 @@ class ChatClient:
                 json={"question": query, "context": context,
                       "use_knowledge_base": use_knowledge_base,
                       "num_tokens": num_tokens},
-                headers=inject_context({}), stream=True,
+                headers=self._headers(request_id), stream=True,
                 timeout=self.timeout) as resp:
             resp.raise_for_status()
             for chunk in resp.iter_content(chunk_size=16,
@@ -71,6 +86,7 @@ class ChatClient:
                 resp = requests.post(
                     f"{self.server_url}/uploadDocument",
                     files={"file": (path.split("/")[-1], f)},
-                    headers=inject_context({}), timeout=self.timeout)
+                    headers=self._headers(), timeout=self.timeout)
             resp.raise_for_status()
-            logger.info("uploaded %s", path)
+            logger.info("uploaded %s (request %s)", path,
+                        self.last_request_id)

@@ -6,6 +6,6 @@ opentelemetry_callback.py) plus the metrics registry the reference lacks
 (SURVEY.md §5: "No first-party metrics registry — a gap to fix").
 """
 
-from . import metrics, tracing
+from . import flight, metrics, tracing
 
-__all__ = ["metrics", "tracing"]
+__all__ = ["flight", "metrics", "tracing"]

@@ -1,4 +1,5 @@
-"""First-party metrics: counters, histograms, TTFT/TPS request timing.
+"""First-party metrics: counters, gauges, histograms (with labels),
+TTFT/TPS request timing.
 
 The reference exposes only Triton's own :8002 metrics port and has a
 "TODO: metrics" in the operator (reference: docker-compose.yaml:13-19,
@@ -6,6 +7,19 @@ helmpipeline_controller.go:109) — no app-level registry at all. This module
 fixes that gap: process-wide registry, Prometheus text rendering, and a
 RequestTimer capturing the serving metrics that matter (time-to-first-token,
 tokens/sec) per request class.
+
+Label support: a metric declared with ``labelnames`` is a parent whose
+``labels(...)`` returns (and memoizes) a child per label-value tuple —
+rendered as ``engine_stage_seconds_bucket{stage="prefill",le="0.05"}``.
+Per-stage latency is therefore a real histogram
+(``engine_stage_seconds{stage=...}``, fed by ``obs.tracing.record_stage``)
+instead of cumulative-ms/count gauge pairs.
+
+Concurrency: every mutation takes the metric's own lock; scrapes
+(``render_prometheus``/``snapshot``/``percentile``) copy histogram state
+UNDER that same lock, so a concurrent ``observe()`` can never yield a
+scrape where cumulative bucket counts disagree with ``_count`` (the
+round-7 torn-read fix, pinned by the observe-while-render stress test).
 """
 
 from __future__ import annotations
@@ -17,15 +31,92 @@ from typing import Optional, Sequence
 _DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.2, 0.4, 0.8, 1.6, 3.2,
                     6.4, 12.8, 30.0, 60.0)
 
+# Tokens-per-second histograms span single-token trickles to full-batch
+# device throughput; the default latency buckets top out at 60.
+TPS_BUCKETS = (0.5, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0,
+               512.0, 1024.0, 2048.0, 4096.0)
+
+# Pipeline stages run sub-millisecond (loop phases) to tens of seconds
+# (a cold compile); extend the default ladder downward.
+STAGE_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                 0.2, 0.4, 0.8, 1.6, 3.2, 6.4, 12.8, 30.0)
+
+
+def _escape(value) -> str:
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _fmt_labels(pairs: Sequence[tuple[str, object]]) -> str:
+    if not pairs:
+        return ""
+    return "{" + ",".join(f'{k}="{_escape(v)}"' for k, v in pairs) + "}"
+
 
 class Counter:
-    def __init__(self, name: str, help_txt: str = ""):
+    _kind = "counter"
+
+    def __init__(self, name: str, help_txt: str = "",
+                 labelnames: Sequence[str] = ()):
         self.name = name
         self.help = help_txt
+        self.labelnames = tuple(labelnames)
         self._value = 0.0
         self._lock = threading.Lock()
+        self._children: dict[tuple, "Counter"] = {}
+
+    # ----------------------------------------------------------- labels
+
+    def labels(self, *values, **kw) -> "Counter":
+        """Child metric for one label-value tuple (memoized). Accepts
+        positional values in ``labelnames`` order or keywords."""
+        if kw:
+            if values:
+                raise ValueError("pass label values positionally OR by "
+                                 "keyword, not both")
+            try:
+                values = tuple(kw.pop(n) for n in self.labelnames)
+            except KeyError as exc:
+                raise ValueError(
+                    f"{self.name}: missing label {exc}") from None
+            if kw:
+                raise ValueError(
+                    f"{self.name}: unknown label(s) {sorted(kw)}")
+        if len(values) != len(self.labelnames):
+            raise ValueError(
+                f"{self.name} expects labels {self.labelnames}, got "
+                f"{len(values)} value(s)")
+        key = tuple(str(v) for v in values)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._make_child()
+                self._children[key] = child
+        return child
+
+    def _make_child(self) -> "Counter":
+        return type(self)(self.name, self.help)
+
+    def _check_scalar(self) -> None:
+        if self.labelnames:
+            raise ValueError(
+                f"{self.name} is declared with labels {self.labelnames}; "
+                f"use .labels(...) to get a child first")
+
+    def _samples(self) -> list[tuple[list, "Counter"]]:
+        """(label pairs, leaf metric) for rendering: the metric itself
+        when unlabeled, else one row per child."""
+        if not self.labelnames:
+            return [([], self)]
+        with self._lock:
+            items = sorted(self._children.items())
+        return [(list(zip(self.labelnames, key)), child)
+                for key, child in items]
+
+    # ------------------------------------------------------------ values
 
     def inc(self, amount: float = 1.0) -> None:
+        self._check_scalar()
         with self._lock:
             self._value += amount
 
@@ -35,23 +126,39 @@ class Counter:
 
 
 class Gauge(Counter):
+    _kind = "gauge"
+
     def set(self, value: float) -> None:
+        self._check_scalar()
         with self._lock:
             self._value = value
 
 
 class Histogram:
+    _kind = "histogram"
+
     def __init__(self, name: str, help_txt: str = "",
-                 buckets: Sequence[float] = _DEFAULT_BUCKETS):
+                 buckets: Sequence[float] = _DEFAULT_BUCKETS,
+                 labelnames: Sequence[str] = ()):
         self.name = name
         self.help = help_txt
         self.buckets = tuple(sorted(buckets))
+        self.labelnames = tuple(labelnames)
         self._counts = [0] * (len(self.buckets) + 1)
         self._sum = 0.0
         self._total = 0
         self._lock = threading.Lock()
+        self._children: dict[tuple, "Histogram"] = {}
+
+    labels = Counter.labels
+    _check_scalar = Counter._check_scalar
+    _samples = Counter._samples
+
+    def _make_child(self) -> "Histogram":
+        return Histogram(self.name, self.help, self.buckets)
 
     def observe(self, value: float) -> None:
+        self._check_scalar()
         with self._lock:
             self._sum += value
             self._total += 1
@@ -61,18 +168,26 @@ class Histogram:
                     return
             self._counts[-1] += 1
 
-    def percentile(self, q: float) -> float:
-        """Approximate percentile from bucket midpoints (p50/p99 health)."""
+    def snapshot_state(self) -> tuple[list[int], float, int]:
+        """(bucket counts, sum, total) copied atomically under the
+        histogram's own lock — the only way scrapes may read state (a
+        lock-free read can tear against a concurrent observe())."""
         with self._lock:
-            if self._total == 0:
-                return 0.0
-            target = q * self._total
-            seen = 0
-            for i, edge in enumerate(self.buckets):
-                seen += self._counts[i]
-                if seen >= target:
-                    return edge
-            return self.buckets[-1]
+            return list(self._counts), self._sum, self._total
+
+    def percentile(self, q: float) -> float:
+        """Approximate percentile from bucket upper edges (p50/p99
+        health)."""
+        counts, _, total = self.snapshot_state()
+        if total == 0:
+            return 0.0
+        target = q * total
+        seen = 0
+        for i, edge in enumerate(self.buckets):
+            seen += counts[i]
+            if seen >= target:
+                return edge
+        return self.buckets[-1]
 
     @property
     def count(self) -> int:
@@ -88,58 +203,124 @@ class Registry:
         self._metrics: dict[str, object] = {}
         self._lock = threading.Lock()
 
-    def _get(self, cls, name: str, help_txt: str, **kw):
+    def _get(self, cls, name: str, help_txt: str, labelnames=(), **kw):
         with self._lock:
             m = self._metrics.get(name)
             if m is None:
-                m = cls(name, help_txt, **kw)
+                m = cls(name, help_txt, labelnames=tuple(labelnames), **kw)
                 self._metrics[name] = m
+            elif type(m) is not cls:
+                raise ValueError(
+                    f"metric {name} already registered as "
+                    f"{type(m).__name__}, not {cls.__name__}")
+            elif m.labelnames != tuple(labelnames):
+                raise ValueError(
+                    f"metric {name} already registered with labels "
+                    f"{m.labelnames}, not {tuple(labelnames)}")
+            elif isinstance(m, Histogram) and "buckets" in kw \
+                    and m.buckets != tuple(sorted(kw["buckets"])):
+                # A silently-ignored ladder mismatch would mis-bucket
+                # every later observation (e.g. TPS samples into a
+                # 60s-max latency ladder, all landing in +Inf).
+                raise ValueError(
+                    f"histogram {name} already registered with buckets "
+                    f"{m.buckets}")
             return m
 
-    def counter(self, name: str, help_txt: str = "") -> Counter:
-        return self._get(Counter, name, help_txt)
+    def counter(self, name: str, help_txt: str = "",
+                labelnames: Sequence[str] = ()) -> Counter:
+        return self._get(Counter, name, help_txt, labelnames)
 
-    def gauge(self, name: str, help_txt: str = "") -> Gauge:
-        return self._get(Gauge, name, help_txt)
+    def gauge(self, name: str, help_txt: str = "",
+              labelnames: Sequence[str] = ()) -> Gauge:
+        return self._get(Gauge, name, help_txt, labelnames)
 
     def histogram(self, name: str, help_txt: str = "",
-                  buckets: Sequence[float] = _DEFAULT_BUCKETS) -> Histogram:
-        return self._get(Histogram, name, help_txt, buckets=buckets)
+                  buckets: Sequence[float] = _DEFAULT_BUCKETS,
+                  labelnames: Sequence[str] = ()) -> Histogram:
+        return self._get(Histogram, name, help_txt, labelnames,
+                         buckets=buckets)
 
     def render_prometheus(self) -> str:
-        """Prometheus text exposition format."""
+        """Prometheus text exposition format. Histogram state is copied
+        under each histogram's lock (snapshot_state), so the rendered
+        cumulative buckets always agree with _count."""
         lines: list[str] = []
         with self._lock:
             metrics = list(self._metrics.values())
         for m in metrics:
+            lines.append(f"# TYPE {m.name} {m._kind}")
             if isinstance(m, Histogram):
-                lines.append(f"# TYPE {m.name} histogram")
-                cum = 0
-                for i, edge in enumerate(m.buckets):
-                    cum += m._counts[i]
-                    lines.append(f'{m.name}_bucket{{le="{edge}"}} {cum}')
-                lines.append(f'{m.name}_bucket{{le="+Inf"}} {m.count}')
-                lines.append(f"{m.name}_sum {m.sum}")
-                lines.append(f"{m.name}_count {m.count}")
+                for pairs, leaf in m._samples():
+                    counts, total_sum, total = leaf.snapshot_state()
+                    cum = 0
+                    for i, edge in enumerate(leaf.buckets):
+                        cum += counts[i]
+                        lines.append(
+                            f"{m.name}_bucket"
+                            f"{_fmt_labels(pairs + [('le', edge)])} {cum}")
+                    lines.append(
+                        f"{m.name}_bucket"
+                        f"{_fmt_labels(pairs + [('le', '+Inf')])} {total}")
+                    lines.append(
+                        f"{m.name}_sum{_fmt_labels(pairs)} {total_sum}")
+                    lines.append(
+                        f"{m.name}_count{_fmt_labels(pairs)} {total}")
             else:
-                kind = "gauge" if isinstance(m, Gauge) else "counter"
-                lines.append(f"# TYPE {m.name} {kind}")
-                lines.append(f"{m.name} {m.value}")
+                for pairs, leaf in m._samples():
+                    lines.append(f"{m.name}{_fmt_labels(pairs)} {leaf.value}")
         return "\n".join(lines) + "\n"
 
     def snapshot(self) -> dict[str, float]:
+        """Flat name -> value map. Labeled children key as
+        ``name{label="value"}`` (and ``name_count{...}``/``name_sum{...}``
+        for histograms)."""
         with self._lock:
-            out: dict[str, float] = {}
-            for name, m in self._metrics.items():
+            metrics = list(self._metrics.items())
+        out: dict[str, float] = {}
+        for name, m in metrics:
+            for pairs, leaf in m._samples():
+                suffix = _fmt_labels(pairs)
                 if isinstance(m, Histogram):
-                    out[f"{name}_count"] = float(m.count)
-                    out[f"{name}_sum"] = m.sum
+                    counts, total_sum, total = leaf.snapshot_state()
+                    out[f"{name}_count{suffix}"] = float(total)
+                    out[f"{name}_sum{suffix}"] = total_sum
                 else:
-                    out[name] = m.value
-            return out
+                    out[name + suffix] = leaf.value
+        return out
 
 
 REGISTRY = Registry()
+
+
+# Per-stage children of the default registry's engine_stage_seconds,
+# memoized so the engine loop's per-iteration record_stage calls cost one
+# dict hit instead of two lock-guarded registry lookups.
+_stage_children: dict[str, Histogram] = {}
+
+
+def _stage_histogram(registry: Registry) -> Histogram:
+    return registry.histogram(
+        "engine_stage_seconds",
+        "per-stage serving-path latency (seconds), labeled by stage",
+        buckets=STAGE_BUCKETS, labelnames=("stage",))
+
+
+def observe_stage(name: str, seconds: float,
+                  registry: Registry = REGISTRY) -> None:
+    """One pipeline-stage latency sample into the labeled
+    ``engine_stage_seconds`` histogram — the scrape-side replacement for
+    eyeballing cumulative-ms/count gauge pairs. Fed by
+    ``obs.tracing.record_stage``, i.e. every event_span and engine stage
+    hook, whether or not tracing or a bench collector is active."""
+    if registry is REGISTRY:
+        child = _stage_children.get(name)
+        if child is None:  # benign race: both writers memoize the same child
+            child = _stage_histogram(registry).labels(name)
+            _stage_children[name] = child
+        child.observe(seconds)
+    else:
+        _stage_histogram(registry).labels(name).observe(seconds)
 
 
 # Engine pipeline stage counters that are cumulative-(ms, events) pairs:
@@ -207,6 +388,12 @@ class RequestTimer:
         dur = time.monotonic() - self._start
         self.registry.histogram(f"{self.name}_duration_seconds").observe(dur)
         if self._tokens and dur > 0:
+            tps = self._tokens / dur
             self.registry.counter(f"{self.name}_tokens_total").inc(self._tokens)
+            # The histogram is the real distribution under concurrency;
+            # the last-write-wins gauge stays published for dashboards
+            # pinned to the old name.
+            self.registry.histogram(f"{self.name}_tokens_per_second",
+                                    buckets=TPS_BUCKETS).observe(tps)
             self.registry.gauge(f"{self.name}_last_tokens_per_second").set(
-                self._tokens / dur)
+                tps)

@@ -9,28 +9,51 @@ Parity with the reference's tracing modules:
 When tracing is disabled (the default) every helper degrades to a no-op —
 zero overhead, no SDK initialization, same as the reference's
 ``if not enabled`` fallthrough wrappers.
+
+Enablement is evaluated PER CALL, not frozen at import: ``enabled()``
+reads the env each time unless ``set_enabled()`` installed an override —
+so config-file-driven ``tracing.enabled`` and tests toggling tracing
+work without a module reimport, and ``enabled()`` / ``inject_context`` /
+``event_span`` / ``instrumented`` all agree on the same check.
 """
 
 from __future__ import annotations
 
 import functools
 import os
+import time
 from contextlib import contextmanager
 from typing import Any, Optional
 
-_ENABLED = os.environ.get("ENABLE_TRACING", "").lower() in ("1", "true", "yes")
+from . import metrics as _metrics
+
+_enabled_override: Optional[bool] = None
 _tracer = None
 
 
 def enabled() -> bool:
-    return _ENABLED
+    if _enabled_override is not None:
+        return _enabled_override
+    return os.environ.get("ENABLE_TRACING", "").lower() in ("1", "true",
+                                                            "yes")
+
+
+def set_enabled(value: Optional[bool]) -> None:
+    """Force tracing on/off at runtime (config-file wiring, tests);
+    ``None`` restores the ``ENABLE_TRACING`` env check."""
+    global _enabled_override
+    _enabled_override = value
 
 
 def _get_tracer():
     """Lazy tracer init (service name 'chain-server' like the reference,
-    common/tracing.py:32-48; OTLP endpoint from the standard env var)."""
+    common/tracing.py:32-48; OTLP endpoint from the standard env var).
+    Returns None whenever tracing is off — a tracer initialized by an
+    earlier enablement does not leak spans after set_enabled(False)."""
     global _tracer
-    if _tracer is None and _ENABLED:
+    if not enabled():
+        return None
+    if _tracer is None:
         from opentelemetry import trace
         try:
             from opentelemetry.sdk.resources import Resource
@@ -82,7 +105,7 @@ def inject_context(headers: Optional[dict] = None) -> dict:
     """Inject current trace context into outgoing headers
     (reference: frontend/tracing.py:47-63)."""
     headers = dict(headers or {})
-    if _ENABLED:
+    if enabled():
         from opentelemetry.propagate import inject
         inject(headers)
     return headers
@@ -96,7 +119,7 @@ def instrumented(name: str):
     def deco(handler):
         @functools.wraps(handler)
         async def wrapper(request, *args: Any, **kwargs: Any):
-            if not _ENABLED:
+            if not enabled():
                 return await handler(request, *args, **kwargs)
             with server_span(name, headers=request.headers,
                              attributes={"http.route": str(request.rel_url)}):
@@ -106,9 +129,12 @@ def instrumented(name: str):
 
 
 # Optional in-process stage-timing hook: callable(stage_name, seconds).
-# Installed by benchmarks/diagnostics (set_stage_collector) to get a
-# per-stage latency breakdown of the serving path without the OTel SDK —
-# every event_span reports its wall time here even when tracing is off.
+# Installed by diagnostics (set_stage_collector) for ad-hoc first-wins
+# capture; record_stage additionally ALWAYS feeds the current request's
+# flight-recorder timeline (obs/flight.py) and the labeled
+# engine_stage_seconds histogram (obs/metrics.py observe_stage), so the
+# per-stage breakdown exists in production scrapes and /debug/requests
+# without any collector installed.
 _stage_collector: Optional[Any] = None
 
 
@@ -119,10 +145,14 @@ def set_stage_collector(cb: Optional[Any]) -> None:
 
 
 def record_stage(name: str, seconds: float) -> None:
-    """Report one stage duration to the installed collector, if any."""
+    """Report one stage duration: to the installed collector (if any),
+    to the bound request timeline, and to the stage histogram."""
     cb = _stage_collector
     if cb is not None:
         cb(name, seconds)
+    from .flight import record_current_stage
+    record_current_stage(name, seconds)
+    _metrics.observe_stage(name, seconds)
 
 
 @contextmanager
@@ -131,9 +161,11 @@ def event_span(kind: str, **attributes: Any):
     reference's LlamaIndex callback→OTel bridge
     (reference: tools/observability/llamaindex/opentelemetry_callback.py:
     84-197 maps QUERY/RETRIEVE/EMBEDDING/SYNTHESIZE/LLM events to spans).
-    Chains call this directly around retrieve/embed/generate stages."""
-    import time as _time
-    t0 = _time.monotonic() if _stage_collector is not None else 0.0
+    Chains call this directly around retrieve/embed/generate stages.
+    The wall time is always reported through record_stage — stage
+    histograms and flight timelines see every span site even with
+    tracing off."""
+    t0 = time.monotonic()
     try:
         tracer = _get_tracer()
         if tracer is None:
@@ -144,5 +176,4 @@ def event_span(kind: str, **attributes: Any):
         with tracer.start_as_current_span(kind, attributes=clean) as span:
             yield span
     finally:
-        if _stage_collector is not None:
-            record_stage(kind, _time.monotonic() - t0)
+        record_stage(kind, time.monotonic() - t0)

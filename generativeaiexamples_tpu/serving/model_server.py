@@ -301,6 +301,14 @@ def create_server_app(engine, embed_service=None,
         return web.Response(text=obs_metrics.REGISTRY.render_prometheus(),
                             content_type="text/plain")
 
+    async def debug_requests(request: web.Request) -> web.Response:
+        # Per-request flight recorder (obs/flight.py): in-flight + last-N
+        # completed timelines for every request this engine served —
+        # the OpenAI/Triton/gRPC surfaces all stamp X-Request-ID (or a
+        # minted cmpl- id) onto their engine submissions.
+        from ..obs import flight as obs_flight
+        return obs_flight.debug_requests_response(request)
+
     # On-demand device profiling (SURVEY §5: the jax.profiler endpoint on
     # the serving engine — the role nsys would play on the reference's
     # stack). POST /profiler/start {"dir": ...} -> trace capture begins;
@@ -427,6 +435,7 @@ def create_server_app(engine, embed_service=None,
 
     app.router.add_get("/health", health)
     app.router.add_get("/metrics", metrics_endpoint)
+    app.router.add_get("/debug/requests", debug_requests)
     app.router.add_post("/v1/score", score)
     app.router.add_post("/profiler/start", profiler_start)
     app.router.add_post("/profiler/stop", profiler_stop)

@@ -104,7 +104,15 @@ def add_openai_routes(app: web.Application, engine, model_name: str,
         except (ValueError, TypeError) as exc:
             raise web.HTTPBadRequest(
                 text=f"invalid sampling parameters: {exc}") from exc
-        rid = f"cmpl-{uuid.uuid4().hex[:24]}"
+        # The completion id doubles as the flight-recorder request ID —
+        # adopted (sanitized, same rules as the chain server) from the
+        # caller's X-Request-ID/traceparent when sent, so one ID names
+        # the API response, the /debug/requests timeline, and the
+        # slow-request dump. Passed explicitly (not via contextvar):
+        # run_in_executor does not propagate context.
+        from ..obs import flight as obs_flight
+        rid = obs_flight.adopt_request_id(
+            request.headers, mint=lambda: f"cmpl-{uuid.uuid4().hex[:24]}")
         created = int(time.time())
         timer = obs_metrics.RequestTimer(f"serve_{kind}")
 
@@ -114,9 +122,14 @@ def add_openai_routes(app: web.Application, engine, model_name: str,
             # Tokenization off the event loop: a long prompt must not stall
             # other in-flight requests on this single-threaded server.
             stream = await loop.run_in_executor(
-                None, engine.stream_text, prompt, params)
+                None, lambda: engine.stream_text(prompt, params,
+                                                 request_id=rid))
         except Exception as exc:  # noqa: BLE001
             raise web.HTTPServiceUnavailable(text=str(exc)) from exc
+        # The response id must BE the timeline key: a duplicate
+        # in-flight X-Request-ID gets a '#N'-suffixed timeline, and the
+        # client must receive the id that /debug/requests answers to.
+        rid = stream.request_id
 
         if body.get("stream"):
             resp = web.StreamResponse(

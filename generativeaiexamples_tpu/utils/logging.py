@@ -26,6 +26,24 @@ def get_logger(name: str) -> logging.Logger:
     return logging.getLogger(name)
 
 
+def log_event(logger: logging.Logger, event: str, *,
+              level: int = logging.WARNING, **fields) -> None:
+    """One-line structured log record: ``<event> {json fields}``.
+
+    The machine-greppable side channel the flight recorder's slow-request
+    dump uses (obs/flight.py): one line per event, the payload a single
+    JSON object, so ``grep slow_request | jq`` reconstructs the whole
+    timeline without a log-parsing pipeline. Values that don't serialize
+    degrade to ``str()`` rather than raising — a log line must never take
+    down the serving path."""
+    import json
+    try:
+        payload = json.dumps(fields, default=str, sort_keys=True)
+    except (TypeError, ValueError):
+        payload = str(fields)
+    logger.log(level, "%s %s", event, payload)
+
+
 def write_termination_log(message: str, path: str | None = None) -> None:
     """Write a k8s termination log if the path is writable.
 

@@ -58,3 +58,41 @@ def test_chat_scenario_survives_cache_disabled():
                                    user_len=10, reply_len=4, warmup=False)
     assert res["prefix_cache_hit_tokens"] == 0
     assert res["prefix_cache_hit_rate"] == 0.0
+
+
+def test_e2e_scenario_breakdown_from_flight_recorder():
+    """Tier-1 smoke of bench.run_e2e_bench: the full HTTP chatbot path on
+    a tiny CPU engine, with the per-stage breakdown sourced from each
+    request's FLIGHT-RECORDER timeline (keyed by the X-Request-ID the
+    bench sends) — chain stages and engine stages on one record. CPU
+    timings are noise; the contract is that the breakdown exists, is
+    schema-legal, and the bench's request IDs landed in the recorder."""
+    from generativeaiexamples_tpu.embed.encoder import get_embedder
+    from generativeaiexamples_tpu.obs import flight
+    from tools.check_bench_schema import load_schema
+
+    params = llama.init_params(CFG, jax.random.key(5), dtype=jnp.float32)
+    eng = Engine(params, CFG, ByteTokenizer(), EngineConfig(
+        max_slots=2, max_input_length=1024, max_output_length=32,
+        prefill_buckets=(128, 1024), dtype="float32",
+        kv_pool_tokens=None, steps_per_round=4))
+    with eng:
+        p50, dist, breakdown, tps_p50 = bench.run_e2e_bench(
+            eng, get_embedder("hash", dim=64), n_requests=3)
+    assert p50 > 0 and dist["samples"] == 3
+    # per-request tokens/sec median computed from timeline
+    # generated/duration — exact, not histogram-bucket-quantized
+    assert tps_p50 is not None and tps_p50 > 0
+    # engine-side stages only exist because the adopted request ID
+    # reached Engine.submit through the chain server's bound context
+    for stage in ("engine_ttft", "engine_admit_dispatch", "llm"):
+        assert stage in breakdown, breakdown
+    # every reported stage is schema-legal (the TPU bench would refuse
+    # to emit otherwise)
+    allowed = set(load_schema()["breakdown_stages"])
+    assert set(breakdown) <= allowed, set(breakdown) - allowed
+    # the bench's request IDs are findable afterwards — the same lookup
+    # an operator does via /debug/requests
+    completed = [t["request_id"]
+                 for t in flight.RECORDER.snapshot(limit=100)["completed"]]
+    assert any(r.startswith("bench-") for r in completed)

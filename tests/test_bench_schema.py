@@ -32,6 +32,7 @@ def synthetic_result() -> dict:
                  "llm": 460.0, "llm_first_chunk": 175.0,
                  "engine_ttft": 172.0, "engine_admit_pickup": 0.4,
                  "engine_admit_dispatch": 3.2,
+                 "engine_prefill_chunk": 2.8,
                  "engine_first_readback": 130.0,
                  "engine_harvest_wait": 140.0,
                  "loop_admit": 3.5, "loop_dispatch": 2.7}
@@ -44,7 +45,7 @@ def synthetic_result() -> dict:
         engine_p50=140.0, engine_p99=150.0, tput=500.0,
         achieved_bw=590.4e9, bw_util=0.72, bw_steady=True,
         chat=chat, e2e_p50=178.0, e2e_dist=dist, e2e_breakdown=breakdown,
-        pipeline=pipeline, quant="int8", kv_quant=None,
+        e2e_tps_p50=32.0, pipeline=pipeline, quant="int8", kv_quant=None,
         weights="random-init", prompt_len=512, out_len=64, slots=8,
         steps_per_round=16, kv_pool_pages=63, device="TPU v5 lite",
         rtt_ms=100.8, n_devices=1, bench_seconds=100.0)

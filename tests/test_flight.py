@@ -1,0 +1,418 @@
+"""Flight-recorder tests: timeline ring semantics, recorder thread
+safety, request-ID adoption/propagation, the /debug/requests endpoint,
+and finish/cancel reasons recorded end to end through a real engine."""
+
+import asyncio
+import json
+import threading
+import time
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+from aiohttp.test_utils import TestClient, TestServer
+
+from generativeaiexamples_tpu.obs import flight
+from generativeaiexamples_tpu.obs.flight import (FlightRecorder, Timeline,
+                                                 adopt_request_id)
+
+
+# ----------------------------------------------------------- ring basics
+
+def test_timeline_ring_eviction_and_dropped_count():
+    tl = Timeline("r1", event_cap=8)
+    for i in range(20):
+        tl.event(f"e{i}", i)
+    events = tl.events_snapshot()
+    assert len(events) == 8
+    # oldest were overwritten: only the last cap events survive, in order
+    assert [e[2] for e in events] == [f"e{i}" for i in range(12, 20)]
+    assert tl.to_dict()["events_dropped"] == 12
+
+
+def test_timeline_value_conventions_render():
+    tl = Timeline("r2")
+    tl.stage("prefill", 0.25)          # float -> duration
+    tl.event("decode_round", 16)       # int -> count
+    tl.event("finish", "eos")          # str -> annotation
+    tl.event("engine_submit")          # None -> marker
+    rendered = {e["event"]: e for e in tl.to_dict()["events"]}
+    assert rendered["prefill"]["dur_ms"] == 250.0
+    assert rendered["decode_round"]["value"] == 16
+    assert rendered["finish"]["value"] == "eos"
+    assert "value" not in rendered["engine_submit"]
+    assert tl.stage_durations() == {"prefill": 0.25}
+
+
+def test_recorder_begin_idempotent_and_completed_ring_bounded():
+    rec = FlightRecorder(completed_cap=16, event_cap=8)
+    tl = rec.begin("shared")
+    assert rec.begin("shared") is tl          # chain + engine share one
+    # an EDGE seeing the same client ID while the first is in flight is
+    # a different request: fresh=True disambiguates instead of merging
+    dup = rec.begin("shared", fresh=True)
+    assert dup is not tl and dup.request_id == "shared#2"
+    rec.complete(dup)
+    rec.complete(tl)
+    rec.complete(tl)                          # idempotent
+    assert rec.find("shared") is tl
+    for i in range(40):
+        rec.complete(rec.begin(f"r{i}"))
+    snap = rec.snapshot(limit=100)
+    assert snap["completed_retained"] == 16
+    assert len(snap["completed"]) == 16
+    assert rec.find("shared") is None         # evicted from the ring
+    assert rec.find("r39") is not None
+
+
+def test_recorder_thread_safety_under_concurrent_append_and_scrape():
+    """Scheduler-thread + harvest-thread appends racing a /debug scraper
+    and a begin/complete churn: no exception, bounded structures, every
+    surviving event well-formed."""
+    rec = FlightRecorder(completed_cap=32, event_cap=16)
+    tl = rec.begin("hot")
+    stop = threading.Event()
+    errors = []
+
+    def appender(name):
+        try:
+            while not stop.is_set():
+                tl.stage(name, 0.001)
+                tl.event("decode_round", 8)
+        except Exception as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    def churner():
+        try:
+            i = 0
+            while not stop.is_set():
+                rec.complete(rec.begin(f"churn-{i}"))
+                i += 1
+        except Exception as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    def scraper():
+        try:
+            while not stop.is_set():
+                snap = rec.snapshot()
+                json.dumps(snap)  # JSON-able under concurrent writes
+                for t in snap["in_flight"] + snap["completed"]:
+                    for e in t["events"]:
+                        assert "event" in e and "t_ms" in e
+        except Exception as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    threads = ([threading.Thread(target=appender, args=(f"s{i}",))
+                for i in range(2)]
+               + [threading.Thread(target=churner),
+                  threading.Thread(target=scraper)])
+    for t in threads:
+        t.start()
+    time.sleep(0.5)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    assert not errors, errors
+    assert len(rec.snapshot(limit=1000)["completed"]) <= 32
+    # ring still ordered after the stampede
+    seqs = [e[0] for e in tl.events_snapshot()]
+    assert seqs == sorted(seqs)
+
+
+def test_adopt_request_id():
+    assert adopt_request_id({"X-Request-ID": "abc-123"}) == "abc-123"
+    # traceparent trace-id adopted when no explicit header
+    tp = "00-0af7651916cd43dd8448eb211c80319c-b7ad6b7169203331-01"
+    assert adopt_request_id({"traceparent": tp}) == \
+        "0af7651916cd43dd8448eb211c80319c"
+    # sanitized: quotes/braces stripped, length capped
+    rid = adopt_request_id({"X-Request-ID": 'a"b{c}' + "x" * 500})
+    assert '"' not in rid and "{" not in rid and len(rid) <= 128
+    # minted when absent — via the caller's minter (the OpenAI surface
+    # keeps its cmpl- id shape on malformed/absent headers)
+    assert adopt_request_id({}) and adopt_request_id(None)
+    assert adopt_request_id({"X-Request-ID": "  "},
+                            mint=lambda: "cmpl-x") == "cmpl-x"
+    assert adopt_request_id({"traceparent": "garbage"},
+                            mint=lambda: "cmpl-y") == "cmpl-y"
+
+
+# --------------------------------------------------- /debug/requests HTTP
+
+def _run(coro):
+    return asyncio.get_event_loop_policy().new_event_loop() \
+        .run_until_complete(coro)
+
+
+def test_debug_requests_endpoint_inflight_vs_completed(monkeypatch):
+    """A mid-generation request shows under in_flight with the adopted
+    X-Request-ID (echoed in the response header); after the stream
+    drains it moves to completed with its finish reason."""
+    from generativeaiexamples_tpu.chains.base import BaseExample
+    from generativeaiexamples_tpu.chains.server import create_app
+
+    rec = FlightRecorder(completed_cap=16)
+    monkeypatch.setattr(flight, "RECORDER", rec)
+
+    release = threading.Event()
+
+    class SlowExample(BaseExample):
+        def llm_chain(self, context, question, num_tokens):
+            yield "first "
+            release.wait(timeout=30)
+            yield "second"
+
+        def rag_chain(self, prompt, num_tokens):
+            yield from self.llm_chain("", prompt, num_tokens)
+
+        def ingest_docs(self, data_dir, filename):
+            pass
+
+    async def fn():
+        client = TestClient(TestServer(create_app(SlowExample())))
+        await client.start_server()
+        try:
+            resp = await client.post(
+                "/generate",
+                json={"question": "q", "use_knowledge_base": False,
+                      "num_tokens": 8},
+                headers={"X-Request-ID": "dbg-1"})
+            assert resp.headers["X-Request-ID"] == "dbg-1"
+            await resp.content.read(6)          # first chunk arrived
+
+            dbg = await (await client.get("/debug/requests")).json()
+            inflight = {t["request_id"]: t for t in dbg["in_flight"]}
+            assert "dbg-1" in inflight
+            assert not inflight["dbg-1"]["done"]
+            assert inflight["dbg-1"]["meta"]["route"] == "/generate"
+
+            release.set()
+            await resp.read()                   # drain to completion
+
+            for _ in range(100):                # worker finishes async
+                dbg = await (await client.get(
+                    "/debug/requests?limit=5")).json()
+                done = {t["request_id"]: t for t in dbg["completed"]}
+                if "dbg-1" in done:
+                    break
+                await asyncio.sleep(0.05)
+            assert "dbg-1" in done
+            assert done["dbg-1"]["meta"]["finish"] == "done"
+            assert not any(t["request_id"] == "dbg-1"
+                           for t in dbg["in_flight"])
+
+            # bad limit is a 400, not a 500
+            assert (await client.get("/debug/requests?limit=x")).status \
+                == 400
+        finally:
+            release.set()
+            await client.close()
+    _run(fn())
+
+
+# ------------------------------------------------------- engine end-to-end
+
+from generativeaiexamples_tpu.engine import (Engine, EngineConfig,  # noqa: E402
+                                             SamplingParams)
+from generativeaiexamples_tpu.models import llama  # noqa: E402
+from generativeaiexamples_tpu.models.configs import LlamaConfig  # noqa: E402
+from generativeaiexamples_tpu.models.tokenizer import ByteTokenizer  # noqa: E402
+
+CFG = LlamaConfig(vocab_size=259 + 5, hidden_size=64, intermediate_size=128,
+                  num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+                  max_position_embeddings=256)
+
+ENGINE_CFG = EngineConfig(max_slots=2, max_input_length=32,
+                          max_output_length=16, prefill_buckets=(16, 32),
+                          dtype="float32", max_queue=16,
+                          steps_per_round=4)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    params = llama.init_params(CFG, jax.random.key(7), dtype=jnp.float32)
+    eng = Engine(params, CFG, ByteTokenizer(), ENGINE_CFG)
+    eng.flight = FlightRecorder(completed_cap=64)
+    with eng:
+        yield eng
+
+
+def test_request_id_stamped_on_stream_and_timeline(engine):
+    stream = engine.submit(
+        engine.tokenizer.encode("hello"),
+        SamplingParams(max_tokens=6, top_k=1, ignore_eos=True),
+        request_id="prop-1")
+    stream.text()
+    assert stream.request_id == "prop-1"
+    tl = engine.flight.find("prop-1")
+    assert tl is not None and tl.done
+    names = [e[2] for e in tl.events_snapshot()]
+    for expected in ("engine_submit", "engine_admit_pickup",
+                     "engine_admit_dispatch", "engine_first_readback",
+                     "engine_ttft", "finish"):
+        assert expected in names, (expected, names)
+    assert tl.meta["finish"] == "length"
+    assert tl.meta["generated"] == 6
+    assert tl.meta["prompt_tokens"] == len(engine.tokenizer.encode("hello"))
+    assert tl.meta["ttft_ms"] is not None
+    # a decode_round token-count event exists (per ROUND, not per token).
+    # The harvest worker appends it just AFTER delivering the round's
+    # tokens, so it can land microseconds after text() returns — poll.
+    deadline = time.monotonic() + 10
+    rounds: list = []
+    while not rounds and time.monotonic() < deadline:
+        rounds = [e[3] for e in tl.events_snapshot()
+                  if e[2] == "decode_round"]
+        if not rounds:
+            time.sleep(0.02)
+    assert rounds and sum(rounds) <= 6
+
+
+def test_request_id_adopted_from_bound_context(engine):
+    """The chain-server path: the ID bound on the calling context (the
+    adopted X-Request-ID) reaches Engine.submit without being passed —
+    header in, same ID on the engine stream and its timeline. The EDGE
+    owns completion: the engine sub-call annotates but must not retire
+    the request's timeline (agent chains run several engine calls per
+    request)."""
+    tl_edge = engine.flight.begin("ctx-77")
+    token = flight.bind(tl_edge)
+    try:
+        stream = engine.submit(
+            engine.tokenizer.encode("abc"),
+            SamplingParams(max_tokens=4, top_k=1, ignore_eos=True))
+    finally:
+        flight.unbind(token)
+    stream.text()
+    assert stream.request_id == "ctx-77"
+    assert stream.timeline is tl_edge          # shared, not a duplicate
+    assert not stream.owns_timeline
+    deadline = time.monotonic() + 10           # harvest thread annotates
+    while tl_edge.meta.get("finish") is None \
+            and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert tl_edge.meta["finish"] == "length"
+    assert not tl_edge.done                    # edge completes, not engine
+    # second sub-call on the same request timeline: stats accumulate
+    token = flight.bind(tl_edge)
+    try:
+        engine.submit(
+            engine.tokenizer.encode("de"),
+            SamplingParams(max_tokens=3, top_k=1, ignore_eos=True)).text()
+    finally:
+        flight.unbind(token)
+    deadline = time.monotonic() + 10
+    while tl_edge.meta.get("generated", 0) < 7 \
+            and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert tl_edge.meta["generated"] == 4 + 3
+    engine.flight.complete(tl_edge)            # the edge's finally
+    assert engine.flight.find("ctx-77").done
+
+
+def test_cancel_reason_recorded(engine):
+    stream = engine.submit(
+        engine.tokenizer.encode("zzzz"),
+        SamplingParams(max_tokens=12, top_k=1, ignore_eos=True),
+        request_id="cxl-1")
+    stream.cancel()
+    stream.text()
+    assert stream.finish_reason == "cancelled"
+    tl = engine.flight.find("cxl-1")
+    assert tl.done and tl.meta["finish"] == "cancelled"
+    finishes = [e[3] for e in tl.events_snapshot() if e[2] == "finish"]
+    assert finishes == ["cancelled"]
+
+
+def test_queue_full_rejection_recorded(engine):
+    """A SchedulerFullError'd submit retires its timeline as 'rejected'
+    instead of leaking a forever-in-flight entry."""
+    import queue as _q
+
+    from generativeaiexamples_tpu.utils.errors import SchedulerFullError
+
+    full_q: "_q.Queue" = _q.Queue(maxsize=1)
+    full_q.put_nowait(("sentinel", None))
+    orig = engine._pending
+    engine._pending = full_q
+    try:
+        with pytest.raises(SchedulerFullError):
+            engine.submit(engine.tokenizer.encode("x"),
+                          SamplingParams(max_tokens=2),
+                          request_id="rej-1")
+    finally:
+        engine._pending = orig
+    tl = engine.flight.find("rej-1")
+    assert tl is not None and tl.done and tl.meta["finish"] == "rejected"
+    assert "rej-1" not in {t.request_id
+                           for t in engine.flight._inflight.values()}
+
+
+def test_slow_request_dump_carries_request_id(engine, caplog):
+    """SLO breach → one structured slow_request log line whose JSON
+    payload carries the same request ID as the timeline."""
+    import logging
+
+    rec = engine.flight
+    old_ttft = rec.slo_ttft_ms
+    rec.slo_ttft_ms = 0.000001  # everything breaches
+    try:
+        with caplog.at_level(logging.WARNING,
+                             logger="generativeaiexamples_tpu.obs.flight"):
+            engine.submit(engine.tokenizer.encode("slow"),
+                          SamplingParams(max_tokens=2, top_k=1,
+                                         ignore_eos=True),
+                          request_id="slo-1").text()
+            # the dump fires on the harvest thread just after the stream
+            # drains — poll briefly for the record
+            deadline = time.monotonic() + 10
+            lines: list = []
+            while time.monotonic() < deadline and not lines:
+                lines = [r.getMessage() for r in caplog.records
+                         if r.getMessage().startswith("slow_request ")]
+                if not lines:
+                    time.sleep(0.02)
+    finally:
+        rec.slo_ttft_ms = old_ttft
+    assert lines, caplog.records
+    payload = json.loads(lines[-1].split(" ", 1)[1])
+    assert payload["request_id"] == "slo-1"
+    assert payload["timeline"]["request_id"] == "slo-1"
+
+
+def test_span_replay_emits_engine_stage_spans(engine, monkeypatch):
+    """With tracing on, completion replays duration events as spans
+    carrying the request ID — engine stages join the request's trace."""
+    from generativeaiexamples_tpu.obs import tracing
+
+    spans = []
+
+    class FakeSpan:
+        def __init__(self, name, attributes):
+            self.name = name
+            self.attributes = attributes
+
+        def end(self, end_time=None):
+            pass
+
+    class FakeTracer:
+        def start_span(self, name, context=None, start_time=None,
+                       attributes=None):
+            span = FakeSpan(name, dict(attributes or {}))
+            spans.append(span)
+            return span
+
+    monkeypatch.setattr(tracing, "_enabled_override", True)
+    monkeypatch.setattr(tracing, "_tracer", FakeTracer())
+    engine.submit(engine.tokenizer.encode("sp"),
+                  SamplingParams(max_tokens=2, top_k=1, ignore_eos=True),
+                  request_id="span-1").text()
+    # completion happens on the harvest thread; wait for the replay
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and not any(
+            s.attributes.get("request.id") == "span-1" for s in spans):
+        time.sleep(0.02)
+    mine = [s for s in spans if s.attributes.get("request.id") == "span-1"]
+    assert {"engine_admit_dispatch", "engine_ttft"} <= {s.name
+                                                        for s in mine}

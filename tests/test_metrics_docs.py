@@ -1,0 +1,27 @@
+"""Tier-1 guard: docs/observability.md's engine gauge table stays in
+sync with Engine.stats() (tools/check_metrics_docs.py) — a stats rename
+can't silently orphan the docs, and a new counter can't ship
+undocumented."""
+
+import pytest
+
+from tools.check_metrics_docs import BEGIN, END, check, documented_gauges
+
+
+def test_docs_gauge_table_matches_engine_stats():
+    assert check() == []
+
+
+def test_checker_flags_ghost_and_missing_gauges():
+    """Sanity of the checker itself: a documented gauge with no stats key
+    is a ghost; dropping a documented row leaves a stats key missing."""
+    ghost = (f"{BEGIN}\n| `engine_requests` | x |\n"
+             f"| `engine_not_a_real_stat` | x |\n{END}")
+    errors = check(ghost)
+    assert any("engine_not_a_real_stat" in e for e in errors)
+    assert any("engine_tokens_generated" in e for e in errors)  # missing
+
+
+def test_checker_requires_markers():
+    with pytest.raises(SystemExit):
+        documented_gauges("no markers here")

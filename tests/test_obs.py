@@ -42,6 +42,92 @@ def test_request_timer_ttft_and_tps():
     assert snap["gen_ttft_seconds_count"] == 1
     assert snap["gen_tokens_total"] == 10
     assert snap["gen_last_tokens_per_second"] > 0
+    # Tokens/sec is ALSO a histogram: the distribution survives
+    # concurrent requests, unlike the last-write-wins gauge above.
+    assert snap["gen_tokens_per_second_count"] == 1
+    assert snap["gen_tokens_per_second_sum"] > 0
+
+
+def test_labeled_counter_and_histogram_render():
+    """Label support: children per label-value tuple, rendered as
+    name{label="value"} rows (histograms get the label next to le)."""
+    reg = Registry()
+    c = reg.counter("hits", labelnames=("route",))
+    c.labels("generate").inc(2)
+    c.labels(route="search").inc()
+    h = reg.histogram("stage_seconds", labelnames=("stage",))
+    h.labels("prefill").observe(0.03)
+    h.labels("prefill").observe(0.3)
+    h.labels("decode").observe(0.1)
+    text = reg.render_prometheus()
+    assert 'hits{route="generate"} 2.0' in text
+    assert 'hits{route="search"} 1.0' in text
+    assert 'stage_seconds_bucket{stage="prefill",le="+Inf"} 2' in text
+    assert 'stage_seconds_count{stage="decode"} 1' in text
+    snap = reg.snapshot()
+    assert snap['hits{route="generate"}'] == 2.0
+    assert snap['stage_seconds_count{stage="prefill"}'] == 2.0
+    # a labeled parent cannot be used as a scalar
+    import pytest
+    with pytest.raises(ValueError):
+        c.inc()
+    with pytest.raises(ValueError):
+        h.observe(0.1)
+    with pytest.raises(ValueError):
+        c.labels("a", "b")
+    # re-registration with different labels is a loud conflict
+    with pytest.raises(ValueError):
+        reg.counter("hits", labelnames=("other",))
+
+
+def test_observe_stage_feeds_labeled_histogram():
+    from generativeaiexamples_tpu.obs.metrics import observe_stage
+
+    reg = Registry()
+    observe_stage("engine_admit_dispatch", 0.004, registry=reg)
+    observe_stage("engine_admit_dispatch", 0.008, registry=reg)
+    observe_stage("retrieve", 0.001, registry=reg)
+    snap = reg.snapshot()
+    assert snap[
+        'engine_stage_seconds_count{stage="engine_admit_dispatch"}'] == 2.0
+    assert snap['engine_stage_seconds_count{stage="retrieve"}'] == 1.0
+
+
+def test_histogram_concurrent_observe_while_render():
+    """Torn-read regression (round-7 satellite): scrapes copy histogram
+    state under the histogram's lock, so the rendered cumulative bucket
+    counts can never disagree with _count. Hammer observe() from
+    threads while rendering and check the monotonic-bucket invariant on
+    every scrape."""
+    import re
+    import threading
+
+    reg = Registry()
+    h = reg.histogram("lat", buckets=(0.1, 0.2, 0.4, 0.8))
+    stop = threading.Event()
+
+    def worker(seed: int):
+        v = 0.05 * (1 + seed)
+        while not stop.is_set():
+            h.observe(v)
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(200):
+            text = reg.render_prometheus()
+            buckets = [int(m) for m in re.findall(
+                r'lat_bucket\{le="[^"]+"\} (\d+)', text)]
+            count = int(re.search(r"lat_count (\d+)", text).group(1))
+            # cumulative buckets must be nondecreasing and end at _count
+            assert buckets == sorted(buckets), buckets
+            assert buckets[-1] == count, (buckets, count)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
 
 
 def test_tracing_disabled_noops():
@@ -52,6 +138,31 @@ def test_tracing_disabled_noops():
         assert span is None
     headers = tracing.inject_context({"a": "b"})
     assert headers == {"a": "b"}
+
+
+def test_set_enabled_overrides_env(monkeypatch):
+    """Enablement is evaluated per call (round-7 satellite): set_enabled
+    flips tracing at runtime — no module reimport — and every check
+    site (enabled / inject_context / _get_tracer) agrees."""
+    monkeypatch.delenv("ENABLE_TRACING", raising=False)
+    monkeypatch.setattr(tracing, "_enabled_override", None)
+    assert not tracing.enabled()
+    tracing.set_enabled(True)
+    try:
+        assert tracing.enabled()
+        tracing.set_enabled(False)
+        assert not tracing.enabled()
+        assert tracing._get_tracer() is None  # no spans after disable
+        assert tracing.inject_context({"a": "b"}) == {"a": "b"}
+        # None restores the env check — now honoring a live env change,
+        # which the old import-frozen _ENABLED could not see
+        tracing.set_enabled(None)
+        monkeypatch.setenv("ENABLE_TRACING", "1")
+        assert tracing.enabled()
+        monkeypatch.delenv("ENABLE_TRACING")
+        assert not tracing.enabled()
+    finally:
+        tracing.set_enabled(None)
 
 
 def test_instrumented_passthrough():
@@ -103,7 +214,7 @@ def test_traced_rag_request_emits_child_spans(monkeypatch):
                 self._stack.pop()
 
     tracer = FakeTracer()
-    monkeypatch.setattr(tracing, "_ENABLED", True)
+    monkeypatch.setattr(tracing, "_enabled_override", True)
     monkeypatch.setattr(tracing, "_tracer", tracer)
 
     from generativeaiexamples_tpu.chains.examples.developer_rag import (

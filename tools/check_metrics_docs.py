@@ -1,0 +1,94 @@
+"""Verify docs/observability.md's engine gauge table against the engine's
+actual ``stats()`` surface (mirror of tools/check_bench_schema.py for the
+metrics docs).
+
+The chain server mirrors every numeric ``Engine.stats()`` key as an
+``engine_*`` gauge at scrape time (obs/metrics.py record_engine_stats), and
+docs/observability.md documents each one in a table fenced by
+
+    <!-- engine-stats:begin --> ... <!-- engine-stats:end -->
+
+This checker enforces BOTH directions inside that fence:
+
+- every documented ``engine_<key>`` gauge corresponds to a real stats key
+  (or a known derived gauge: the ``_avg`` pairs record_engine_stats
+  computes) — so a stats rename can't leave the docs describing a ghost;
+- every stats key is documented — so a new counter can't ship invisible.
+
+Registry-level metrics that are NOT stats mirrors (the labeled
+``engine_stage_seconds`` histogram) live OUTSIDE the fence and are not
+checked here.
+
+Runs in tier-1 via tests/test_metrics_docs.py; CLI:
+``python tools/check_metrics_docs.py`` exits non-zero listing every
+mismatch.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOC_PATH = os.path.join(REPO, "docs", "observability.md")
+BEGIN = "<!-- engine-stats:begin -->"
+END = "<!-- engine-stats:end -->"
+
+_GAUGE_RE = re.compile(r"`engine_([a-z0-9_]+)`")
+
+
+def documented_gauges(doc_text: str) -> set[str]:
+    """engine_* names inside the fenced gauge table (backtick-quoted)."""
+    try:
+        start = doc_text.index(BEGIN) + len(BEGIN)
+        end = doc_text.index(END, start)
+    except ValueError:
+        raise SystemExit(
+            f"{DOC_PATH}: missing {BEGIN}/{END} markers around the engine "
+            f"gauge table — the docs checker needs them to scope its scan")
+    return {"engine_" + m for m in _GAUGE_RE.findall(doc_text[start:end])}
+
+
+def expected_gauges() -> tuple[set[str], set[str]]:
+    """(stats-mirrored gauges, derived gauges record_engine_stats adds)."""
+    from generativeaiexamples_tpu.engine.engine import engine_stat_keys
+    from generativeaiexamples_tpu.obs.metrics import ENGINE_STAGE_AVGS
+    stats = {"engine_" + k for k in engine_stat_keys()}
+    derived = {f"engine_{total}_avg" for total, _ in ENGINE_STAGE_AVGS}
+    return stats, derived
+
+
+def check(doc_text: str | None = None) -> list[str]:
+    """Every mismatch between the docs table and the stats surface;
+    empty on a clean tree."""
+    if doc_text is None:
+        with open(DOC_PATH) as f:
+            doc_text = f.read()
+    documented = documented_gauges(doc_text)
+    stats, derived = expected_gauges()
+    errors = []
+    for name in sorted(documented - stats - derived):
+        errors.append(
+            f"docs/observability.md documents {name} but Engine.stats() "
+            f"has no such key (stale doc after a stats rename?)")
+    for name in sorted((stats | derived) - documented):
+        errors.append(
+            f"Engine.stats() exposes {name} but docs/observability.md's "
+            f"gauge table does not document it")
+    return errors
+
+
+def main() -> int:
+    errors = check()
+    if errors:
+        for e in errors:
+            print(f"FAIL — {e}")
+        return 1
+    print(f"{DOC_PATH}: engine gauge table in sync with Engine.stats()")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, REPO)
+    sys.exit(main())
