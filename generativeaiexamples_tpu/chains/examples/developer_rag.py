@@ -16,9 +16,12 @@ import os
 from typing import Generator, Optional
 
 from ...embed.encoder import get_embedder
+from ...obs import flight as obs_flight
+from ...obs import metrics as obs_metrics
 from ...obs.tracing import event_span
 from ...retrieval.docstore import Document, DocumentIndex
 from ...utils.app_config import get_config
+from ...utils.errors import BreakerOpenError, RetrievalError
 from ...utils.logging import get_logger
 from ..base import BaseExample
 from ..llm import get_llm
@@ -26,6 +29,47 @@ from ..readers import read_document
 from ..splitter import TokenTextSplitter, cap_context
 
 logger = get_logger(__name__)
+
+#: User-visible preamble when retrieval is down and the answer comes from
+#: the model alone (docs/robustness.md "Graceful degradation").
+DEGRADED_NOTICE = ("[notice] the knowledge base is temporarily "
+                   "unavailable; answering from the model alone.\n\n")
+
+
+def record_degraded(reason: str) -> None:
+    """Count a degraded answer and stamp the request's flight timeline —
+    the signal that separates 'quality dip' from 'outage' on /metrics."""
+    obs_metrics.REGISTRY.counter(
+        "degraded_total", "requests served degraded, by failed dependency",
+        labelnames=("reason",)).labels(reason).inc()
+    tl = obs_flight.current()
+    if tl is not None:
+        tl.annotate(degraded=reason)
+
+
+def degrade_to_llm(chatbot, exc, prompt: str, num_tokens: int,
+                   ) -> Generator[str, None, None]:
+    """Retrieval-down fallback shared by the example chains: notice +
+    LLM-only answer. The fallback's FIRST chunk is pulled before
+    anything is yielded — if the LLM is down too, its typed error
+    propagates with nothing emitted, so the chain server still maps it
+    to a real pre-stream HTTP status (and the engine breaker still sees
+    the failure) instead of a 200 carrying notice-then-error text. The
+    degraded counter likewise only increments once the fallback is
+    actually serving."""
+    reason = (getattr(exc, "reason", "") or
+              getattr(exc, "breaker", "") or "retrieval")
+    logger.warning("rag chain degrading to llm_chain (%s): %s", reason, exc)
+    fallback = chatbot.llm_chain("", prompt, num_tokens)
+    try:
+        first = next(fallback)
+    except StopIteration:
+        first = None
+    record_degraded(reason)
+    yield DEGRADED_NOTICE
+    if first is not None:
+        yield first
+    yield from fallback
 
 
 class QAChatbot(BaseExample):
@@ -186,13 +230,21 @@ class QAChatbot(BaseExample):
         # events the reference bridges out of LlamaIndex callbacks
         # (reference: tools/observability/llamaindex/
         # opentelemetry_callback.py:84-197).
-        with event_span("retrieve", top_k=self.config.retriever.top_k) as sp:
-            docs = self.index.similarity_search(
-                prompt, k=self.config.retriever.top_k)
-            if sp is not None:
-                for i, d in enumerate(docs):
-                    sp.set_attribute(f"retrieval.score.{i}",
-                                     float(d.score or 0.0))
+        try:
+            with event_span("retrieve",
+                            top_k=self.config.retriever.top_k) as sp:
+                docs = self.index.similarity_search(
+                    prompt, k=self.config.retriever.top_k)
+                if sp is not None:
+                    for i, d in enumerate(docs):
+                        sp.set_attribute(f"retrieval.score.{i}",
+                                         float(d.score or 0.0))
+        except (RetrievalError, BreakerOpenError) as exc:
+            # Graceful degradation: a dead vector store or embedder
+            # costs retrieval QUALITY, not the whole chatbot. Answer
+            # from the model alone, tell the user, count it.
+            yield from degrade_to_llm(self, exc, prompt, num_tokens)
+            return
         with event_span("templating", n_docs=len(docs)):
             context_texts = cap_context(
                 [d.text for d in docs],

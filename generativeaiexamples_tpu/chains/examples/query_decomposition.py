@@ -22,7 +22,9 @@ from typing import Generator, Optional
 from ...embed.encoder import get_embedder
 from ...retrieval.docstore import Document, DocumentIndex
 from ...utils.app_config import get_config
+from ...utils.errors import BreakerOpenError, RetrievalError
 from ...utils.logging import get_logger
+from .developer_rag import degrade_to_llm
 from ..base import BaseExample
 from ..llm import get_llm
 from ..readers import read_document
@@ -189,7 +191,15 @@ class QueryDecompositionChatbot(BaseExample):
 
     def rag_chain(self, prompt: str, num_tokens: int,
                   ) -> Generator[str, None, None]:
-        ledger = self.run_agent(prompt)
+        try:
+            ledger = self.run_agent(prompt)
+        except (RetrievalError, BreakerOpenError) as exc:
+            # Retrieval-layer failure inside the agent loop: degrade to
+            # a direct LLM answer with a notice instead of erroring the
+            # whole request (LLM failures still propagate — there is
+            # nothing to degrade TO without a model).
+            yield from degrade_to_llm(self, exc, prompt, num_tokens)
+            return
         # final synthesis streamed (reference: extract_answer, chains.py:278)
         yield from self.llm.stream(
             FINAL_PROMPT.format(question=prompt, ledger=ledger.render()),

@@ -17,6 +17,20 @@ Sync chain generators run on a worker thread; chunks cross into the event
 loop through an asyncio queue, so one slow generation never blocks other
 requests (the aiohttp equivalent of FastAPI's StreamingResponse-over-
 threadpool).
+
+Robustness contract (docs/robustness.md):
+
+- failures BEFORE the first generated chunk return real HTTP statuses
+  with a JSON body and ``X-Request-ID`` — 429 + ``Retry-After`` for an
+  overloaded engine queue or an unmeetable deadline, 503 for a
+  down/breaker-open engine, 504 for a hung store, 500 otherwise — never
+  a 200 SSE carrying ``[error]`` text;
+- failures AFTER streaming has begun keep the in-stream degrade (the
+  partial answer already went out on a 200) but append a
+  machine-readable ``event: error`` frame clients can parse;
+- per-request deadlines (``X-Deadline-Ms``, config/env default) ride the
+  flight-recorder contextvar into the engine, which drops expired queued
+  requests before prefill and stops decode when the deadline passes.
 """
 
 from __future__ import annotations
@@ -25,6 +39,7 @@ import asyncio
 import importlib
 import inspect
 import json
+import math
 import os
 from typing import Optional
 
@@ -34,11 +49,39 @@ from ..obs import flight as obs_flight
 from ..obs import metrics as obs_metrics
 from ..obs.tracing import instrumented
 from ..serving.streaming import iterate_in_thread
-from ..utils.errors import ChainError
+from ..utils import resilience
+from ..utils.errors import (BreakerOpenError, ChainError, EngineError,
+                            SchedulerFullError)
 from ..utils.logging import get_logger
 from .base import BaseExample
 
 logger = get_logger(__name__)
+
+
+def error_response(status: int, err_type: str, message: str, rid: str,
+                   retry_after_s: Optional[float] = None) -> web.Response:
+    """Structured error: JSON body + ``X-Request-ID`` (quote it to
+    /debug/requests) + ``Retry-After`` when the failure is retryable."""
+    headers = {"X-Request-ID": rid}
+    if retry_after_s is not None:
+        headers["Retry-After"] = str(max(1, int(math.ceil(retry_after_s))))
+    return web.json_response(
+        {"error": {"type": err_type, "message": message},
+         "request_id": rid},
+        status=status, headers=headers)
+
+
+def _shed(reason: str) -> None:
+    obs_metrics.REGISTRY.counter(
+        "shed_total", "requests rejected at admission, by reason",
+        labelnames=("reason",)).labels(reason).inc()
+
+
+try:  # typed app-state key (aiohttp >= 3.9); tests reach the breaker by it
+    GENERATE_BREAKER = web.AppKey("generate_breaker",
+                                  resilience.CircuitBreaker)
+except AttributeError:  # older aiohttp: plain string key
+    GENERATE_BREAKER = "generate_breaker"  # type: ignore[assignment]
 
 
 def discover_example(spec: str) -> type[BaseExample]:
@@ -61,8 +104,35 @@ def discover_example(spec: str) -> type[BaseExample]:
 
 
 def create_app(example: BaseExample,
-               upload_dir: str = "./uploaded_files") -> web.Application:
+               upload_dir: str = "./uploaded_files",
+               config=None) -> web.Application:
     app = web.Application(client_max_size=100 * 1024 ** 2)
+
+    # Robustness knobs: app-config `serving` section, env-overridable
+    # (REQUEST_DEADLINE_MS / CHAIN_EXECUTOR_TIMEOUT_S win over the file —
+    # chaos runs flip them without a config edit).
+    try:
+        if config is None:
+            from ..utils.app_config import get_config
+            config = get_config()
+        rcfg = config.serving
+    except Exception:  # noqa: BLE001 — config problems must not kill boot
+        from ..utils.app_config import ServingRobustnessConfig
+        rcfg = ServingRobustnessConfig()
+    default_deadline_ms = float(os.environ.get(
+        "REQUEST_DEADLINE_MS", rcfg.default_deadline_ms) or 0) or None
+    executor_timeout_s = float(os.environ.get(
+        "CHAIN_EXECUTOR_TIMEOUT_S", rcfg.request_timeout_s) or 0) or None
+    ingest_timeout_s = float(os.environ.get(
+        "CHAIN_INGEST_TIMEOUT_S",
+        getattr(rcfg, "ingest_timeout_s", 300.0)) or 0) or None
+    admission_min = int(rcfg.admission_min_samples)
+    # Private breaker instance (not the shared registry): each app's
+    # failure count is its own, so one test server's tripped breaker
+    # can't fast-503 the next. State still lands on /metrics by name.
+    breaker = resilience.CircuitBreaker(
+        "chain_generate", rcfg.breaker_failures, rcfg.breaker_cooldown_s)
+    app[GENERATE_BREAKER] = breaker
 
     async def health(request: web.Request) -> web.Response:
         return web.json_response({"status": "ok"})
@@ -85,13 +155,26 @@ def create_app(example: BaseExample,
                 if not chunk:
                     break
                 f.write(chunk)
+        rid = obs_flight.adopt_request_id(request.headers)
         try:
-            await asyncio.get_running_loop().run_in_executor(
-                None, example.ingest_docs, path, filename)
+            # Bounded: a hung store must cost the caller 504, not pin
+            # this worker thread forever. (The executor thread itself
+            # cannot be killed; the timeout frees the HTTP slot.)
+            await asyncio.wait_for(
+                asyncio.get_running_loop().run_in_executor(
+                    None, example.ingest_docs, path, filename),
+                timeout=ingest_timeout_s)
+        except asyncio.TimeoutError:
+            logger.error("ingest timed out for %s after %ss", filename,
+                         ingest_timeout_s)
+            return error_response(
+                504, "timeout",
+                f"ingest of {filename} exceeded {ingest_timeout_s}s",
+                rid)
         except Exception as exc:  # noqa: BLE001 — degrade like the reference
             logger.exception("ingest failed for %s", filename)
-            raise web.HTTPInternalServerError(
-                text=f"ingest failed: {exc}") from exc
+            return error_response(500, "ingest_error",
+                                  f"ingest failed: {exc}", rid)
         obs_metrics.REGISTRY.counter("documents_ingested_total").inc()
         return web.json_response({"filename": filename, "status": "ingested"})
 
@@ -111,40 +194,82 @@ def create_app(example: BaseExample,
         # /debug/requests, the engine's stream, and the slow-request
         # dump. Echoed back so callers can correlate without sending one.
         rid = obs_flight.adopt_request_id(request.headers)
+
+        # Breaker fast-path: a generation path that keeps failing is
+        # DOWN — reject in microseconds instead of queueing doomed work
+        # behind a dead engine. Half-open lets one probe through.
+        if not breaker.allow():
+            _shed("breaker_open")
+            return error_response(
+                503, "engine_unavailable",
+                "generation is failing; circuit breaker open", rid,
+                retry_after_s=breaker.retry_after_s()
+                or rcfg.breaker_cooldown_s)
+        # Breaker outcome must be resolved on EVERY exit path, or a
+        # half-open probe would stay in flight forever and wedge the
+        # breaker. Three resolutions: success/failure when the engine
+        # was actually exercised (only engine connectivity counts as
+        # failure), release when it wasn't — a shed, a chain-side bug,
+        # or a client cancellation proves nothing about the engine and
+        # must not close a half-open breaker.
+        reported = [False]
+
+        def report(ok: bool) -> None:
+            if not reported[0]:
+                reported[0] = True
+                (breaker.record_success if ok
+                 else breaker.record_failure)()
+
+        def release() -> None:
+            if not reported[0]:
+                reported[0] = True
+                breaker.release_probe()
+
         # fresh: a retry racing its original under the same client ID
         # gets its own (#N-suffixed) timeline, never the original's.
         timeline = obs_flight.RECORDER.begin(rid, fresh=True)
         rid = timeline.request_id
         timeline.annotate(route="/generate", use_kb=use_kb,
                           num_tokens=num_tokens)
-
-        resp = web.StreamResponse(
-            headers={"Content-Type": "text/event-stream",
-                     "Cache-Control": "no-cache",
-                     "X-Request-ID": rid})
-        try:
-            await resp.prepare(request)
-        except BaseException:
-            # Client vanished before headers went out: run_chain (whose
-            # finally completes the timeline) never starts — retire it
-            # here or it would sit in the in-flight map forever.
-            timeline.annotate(finish="disconnected")
-            obs_flight.RECORDER.complete(timeline)
-            raise
+        deadline_ms = obs_flight.adopt_deadline_ms(request.headers,
+                                                   default_deadline_ms)
+        if deadline_ms is not None:
+            timeline.set_deadline(deadline_ms)
+            # Admission control: if recent requests waited longer in the
+            # engine queue than this caller's whole budget, admitting it
+            # is hopeless — shed NOW with an honest Retry-After instead
+            # of streaming a deadline_queue drop seconds later.
+            n, wait_ms = obs_flight.RECORDER.recent_stage_ms(
+                "engine_admit_pickup")
+            if n >= admission_min and wait_ms > deadline_ms:
+                _shed("deadline_unmeetable")
+                timeline.annotate(finish="shed", shed="deadline_unmeetable",
+                                  est_queue_wait_ms=round(wait_ms, 1))
+                obs_flight.RECORDER.complete(timeline)
+                release()  # engine never probed
+                return error_response(
+                    429, "deadline_unmeetable",
+                    f"estimated queue wait {wait_ms:.0f} ms exceeds the "
+                    f"request deadline {deadline_ms:.0f} ms", rid,
+                    retry_after_s=wait_ms / 1e3)
 
         def run_chain():
-            """Generator wrapping the chain: per-token metrics + degrade to
-            a user-readable error in-stream (reference: server.py:136-142).
-            Runs on a worker thread under the request's copied context
-            (iterate_in_thread), so the timeline bound here is visible to
-            every stage below it — including Engine.submit."""
+            """Generator wrapping the chain: per-token metrics; failures
+            BEFORE the first chunk re-raise (the handler maps them to
+            real HTTP statuses); failures after degrade in-stream
+            (reference: server.py:136-142) plus a machine-readable final
+            event. Runs on a worker thread under the request's copied
+            context (iterate_in_thread), so the timeline bound here is
+            visible to every stage below it — including Engine.submit."""
             token = obs_flight.bind(timeline)
             timer = obs_metrics.RequestTimer("chain_generate")
+            emitted = False
             try:
                 gen = (example.rag_chain(question, num_tokens) if use_kb
                        else example.llm_chain(context, question, num_tokens))
                 for chunk in gen:
                     timer.token(1)
+                    emitted = True
                     yield chunk
             except GeneratorExit:
                 # Consumer abandoned the stream (client disconnect):
@@ -152,9 +277,17 @@ def create_app(example: BaseExample,
                 timeline.meta.setdefault("finish", "disconnected")
                 raise
             except Exception as exc:  # noqa: BLE001
-                logger.exception("generation failed")
-                timeline.annotate(finish="error", error=str(exc))
+                # setdefault: an engine-recorded reason (e.g. the
+                # queue-full 'rejected') is more precise — keep it.
+                timeline.meta.setdefault("finish", "error")
+                timeline.meta.setdefault("error", str(exc))
+                if not emitted:
+                    raise  # pre-stream: becomes a real HTTP status
+                logger.exception("generation failed mid-stream")
                 yield f"\n[error] {exc}"
+                yield ("\n\nevent: error\ndata: " + json.dumps(
+                    {"error": type(exc).__name__, "message": str(exc),
+                     "request_id": rid}) + "\n\n")
             finally:
                 timer.finish()
                 obs_flight.unbind(token)
@@ -164,8 +297,71 @@ def create_app(example: BaseExample,
                 timeline.meta.setdefault("finish", "done")
                 obs_flight.RECORDER.complete(timeline)
 
+        # Pull the FIRST chunk before committing to a 200: everything
+        # that can go wrong pre-stream (queue full, dead engine, broken
+        # chain) surfaces here as a typed exception with a real status.
+        agen = iterate_in_thread(run_chain())
         try:
-            async for chunk in iterate_in_thread(run_chain()):
+            first: Optional[str] = await agen.__anext__()
+        except StopAsyncIteration:
+            first = None  # empty generation
+            # A deadline enforced before ANY output (dropped in queue,
+            # or stopped at the very first token) produced nothing the
+            # caller can use — that is a 504, not an empty 200.
+            if timeline.meta.get("finish") in ("deadline_queue", "deadline"):
+                report(True)  # engine answered (by dropping) — not down
+                return error_response(
+                    504, "deadline_exceeded",
+                    f"request deadline ({timeline.meta.get('deadline_ms')}"
+                    f" ms) expired before any output "
+                    f"({timeline.meta['finish']})", rid)
+        except SchedulerFullError as exc:
+            report(True)  # the engine is alive — just saturated
+            _shed("queue_full")
+            _, wait_ms = obs_flight.RECORDER.recent_stage_ms(
+                "engine_admit_pickup")
+            return error_response(429, "queue_full", str(exc), rid,
+                                  retry_after_s=max(1.0, wait_ms / 1e3))
+        except BreakerOpenError as exc:
+            release()  # a DOWNSTREAM breaker tripped; engine not probed
+            _shed("breaker_open")
+            return error_response(503, "dependency_unavailable", str(exc),
+                                  rid, retry_after_s=exc.retry_after_s)
+        except EngineError as exc:
+            report(False)  # engine down/failing: feeds the fast-503 breaker
+            return error_response(503, "engine_error", str(exc), rid)
+        except ChainError as exc:
+            release()  # chain-side failure says nothing about the engine
+            return error_response(500, "chain_error", str(exc), rid)
+        except Exception as exc:  # noqa: BLE001
+            release()
+            logger.exception("generation failed before first chunk")
+            return error_response(500, "internal_error", str(exc), rid)
+        except BaseException:
+            # Client cancellation (or worse) while waiting on the first
+            # chunk: release the probe — NOT an outcome — and close the
+            # generator so run_chain's finally retires the timeline.
+            release()
+            await agen.aclose()
+            raise
+        report(True)
+
+        resp = web.StreamResponse(
+            headers={"Content-Type": "text/event-stream",
+                     "Cache-Control": "no-cache",
+                     "X-Request-ID": rid})
+        try:
+            await resp.prepare(request)
+        except BaseException:
+            # Client vanished before headers went out: closing the
+            # generator runs run_chain's finally, which retires the
+            # timeline (finish=disconnected via GeneratorExit).
+            await agen.aclose()
+            raise
+        try:
+            if first is not None:
+                await resp.write(first.encode("utf-8"))
+            async for chunk in agen:
                 await resp.write(chunk.encode("utf-8"))
             await resp.write_eof()
         except (ConnectionResetError, ConnectionError):
@@ -181,8 +377,23 @@ def create_app(example: BaseExample,
         search = getattr(example, "document_search", None)
         if search is None:
             return web.json_response([])
-        result = await asyncio.get_running_loop().run_in_executor(
-            None, search, content, num_docs)
+        rid = obs_flight.adopt_request_id(request.headers)
+        try:
+            # Bounded: a hung vector store returns 504 instead of
+            # blocking this endpoint (and its executor slot) forever.
+            result = await asyncio.wait_for(
+                asyncio.get_running_loop().run_in_executor(
+                    None, search, content, num_docs),
+                timeout=executor_timeout_s)
+        except asyncio.TimeoutError:
+            logger.error("document search timed out after %ss",
+                         executor_timeout_s)
+            return error_response(
+                504, "timeout",
+                f"document search exceeded {executor_timeout_s}s", rid)
+        except Exception as exc:  # noqa: BLE001
+            logger.exception("document search failed")
+            return error_response(500, "search_error", str(exc), rid)
         return web.json_response(result)
 
     async def metrics_endpoint(request: web.Request) -> web.Response:

@@ -63,6 +63,7 @@ from ..obs.tracing import record_stage
 from ..ops.sampling import apply_repetition_penalty, sample, seen_mask
 from ..parallel.sharding import (llama_param_specs, paged_kv_cache_spec,
                                  shard_params)
+from ..utils import faults
 from ..utils.errors import ConfigError, EngineError, SchedulerFullError
 from .detokenizer import IncrementalDetokenizer, StopChecker
 from .prefix_cache import PrefixCache, hash_blocks, usable_prefix_tokens
@@ -90,6 +91,13 @@ _STATS_TEMPLATE = {
     # idle, so artifacts sampled after a run need the peak to show the
     # overlap actually happened.
     "dispatch_depth_peak": 0,
+    # Robustness counters: submissions rejected at the queue (shed as
+    # 429 at the HTTP edge), queued requests dropped because their
+    # deadline expired before admission (they never reach prefill), and
+    # decodes stopped mid-generation by a passing deadline.
+    "rejected_full": 0,
+    "deadline_queue_drops": 0,
+    "deadline_stops": 0,
 }
 
 
@@ -373,6 +381,10 @@ class _Request:
     # Fused-RAG payload (q_llm (Sq,) int32, q_llm_len, q_enc (2, Se)):
     # admission runs the on-device retrieve+assemble+prefill program.
     rag: Optional[tuple] = None
+    # Absolute (monotonic) deadline: queued past it → dropped before
+    # prefill (finish deadline_queue); passed mid-decode → stopped at
+    # the next harvested token (finish deadline).
+    deadline_t: Optional[float] = None
 
     @property
     def done(self) -> bool:
@@ -1675,10 +1687,27 @@ class Engine:
         stream._flight = self.flight
         return stream
 
+    def _resolve_deadline(self, stream: TokenStream,
+                          deadline_t: Optional[float]) -> Optional[float]:
+        """The request's effective deadline: the explicit argument (the
+        OpenAI surface passes it — run_in_executor drops context), else
+        whatever the serving edge armed on the adopted timeline. An
+        explicit deadline is stamped back onto an unarmed timeline so
+        /debug/requests shows the budget the request ran against."""
+        tl = stream.timeline
+        if deadline_t is None:
+            return tl.deadline_t if tl is not None else None
+        if tl is not None and tl.deadline_t is None:
+            tl.set_deadline((deadline_t - tl.t_start) * 1e3)
+            # set_deadline recomputes off t_start; pin the exact value
+            tl.deadline_t = deadline_t
+        return deadline_t
+
     def submit_rag(self, question_ids: Sequence[int],
                    question_enc_ids: Sequence[int],
                    params: Optional[SamplingParams] = None,
-                   request_id: Optional[str] = None) -> TokenStream:
+                   request_id: Optional[str] = None,
+                   deadline_t: Optional[float] = None) -> TokenStream:
         """Enqueue a fused-RAG request: retrieval and prompt assembly
         happen on-device during admission; ``question_ids`` are the
         question's tokens in the LLM vocab (no BOS), ``question_enc_ids``
@@ -1726,33 +1755,52 @@ class Engine:
                        banned_ids=banned_ids, bad_seqs=bad_seqs,
                        banned_np=banned_np, bad_seq_np=bad_seq_np,
                        bad_len_np=bad_len_np,
-                       rag=(q_llm, len(ids), q_enc))
+                       rag=(q_llm, len(ids), q_enc),
+                       deadline_t=self._resolve_deadline(stream, deadline_t))
         try:
             self._pending.put_nowait((req, params))
         except queue.Full:
-            # Retire the timeline (reason recorded): rejected admissions
-            # show up in /debug/requests instead of leaking as forever-
-            # in-flight entries.
-            stream.timeline.annotate(finish="rejected")
-            self.flight.complete(stream.timeline)
-            raise SchedulerFullError(
-                f"request queue full ({self.cfg.max_queue})") from None
+            self._reject_full(stream)
         if self._fatal is not None:
             stream._fail(self._fatal)
         self._bump("requests")
         self._wake.set()
         return stream
 
+    def _reject_full(self, stream: TokenStream) -> None:
+        """Queue-full rejection: count the shed, retire the timeline
+        (reason recorded, so rejected admissions show up in
+        /debug/requests instead of leaking as forever-in-flight
+        entries) — but only when this stream OWNS it; an edge-adopted
+        timeline is completed by the edge, which turns this exception
+        into a structured 429."""
+        self._bump("rejected_full")
+        tl = stream.timeline
+        if tl is not None:
+            tl.annotate(finish="rejected")
+            if stream.owns_timeline:
+                self.flight.complete(tl)
+        raise SchedulerFullError(
+            f"request queue full ({self.cfg.max_queue})") from None
+
     def submit(self, prompt_ids: Sequence[int],
                params: Optional[SamplingParams] = None,
-               request_id: Optional[str] = None) -> TokenStream:
+               request_id: Optional[str] = None,
+               deadline_t: Optional[float] = None) -> TokenStream:
         """Enqueue a request; returns its stream immediately.
 
         ``request_id``: the end-to-end request identity (see
         TokenStream). Omitted, it is adopted from the calling context
         (obs/flight.py contextvar — how the chain server's
         ``X-Request-ID`` reaches the engine without threading a parameter
-        through every BaseExample chain) or minted fresh."""
+        through every BaseExample chain) or minted fresh.
+
+        ``deadline_t``: absolute ``time.monotonic`` deadline. Omitted,
+        it is adopted from the same contextvar timeline (the chain
+        server arms it from ``X-Deadline-Ms``). Expired in queue → the
+        request is dropped before prefill (finish ``deadline_queue``);
+        passed mid-decode → generation stops at the next harvested
+        token (finish ``deadline``)."""
         if self._fatal is not None:
             raise EngineError("engine is dead") from self._fatal
         params = params or SamplingParams()
@@ -1781,17 +1829,12 @@ class Engine:
                        greedy=(params.top_k == 1 or params.temperature <= 0),
                        banned_ids=banned_ids, bad_seqs=bad_seqs,
                        banned_np=banned_np, bad_seq_np=bad_seq_np,
-                       bad_len_np=bad_len_np)
+                       bad_len_np=bad_len_np,
+                       deadline_t=self._resolve_deadline(stream, deadline_t))
         try:
             self._pending.put_nowait((req, params))
         except queue.Full:
-            # Retire the timeline (reason recorded): rejected admissions
-            # show up in /debug/requests instead of leaking as forever-
-            # in-flight entries.
-            stream.timeline.annotate(finish="rejected")
-            self.flight.complete(stream.timeline)
-            raise SchedulerFullError(
-                f"request queue full ({self.cfg.max_queue})") from None
+            self._reject_full(stream)
         if self._fatal is not None:
             # The loop may have died between the check above and the put;
             # fail the stream here so callers never block forever.
@@ -1810,10 +1853,11 @@ class Engine:
 
     def stream_text(self, prompt: str,
                     params: Optional[SamplingParams] = None,
-                    request_id: Optional[str] = None) -> TokenStream:
+                    request_id: Optional[str] = None,
+                    deadline_t: Optional[float] = None) -> TokenStream:
         self.start()
         return self.submit(self.tokenizer.encode(prompt), params,
-                           request_id=request_id)
+                           request_id=request_id, deadline_t=deadline_t)
 
     # ------------------------------------------------------------ scheduler
 
@@ -1979,6 +2023,7 @@ class Engine:
                     item = self._harvest_q.get(timeout=0.05)
                 except queue.Empty:
                     continue
+                faults.inject("engine.harvest")  # chaos: readback failure
                 kind = item[0]
                 t0 = time.monotonic()
                 if kind == "first":
@@ -2067,6 +2112,21 @@ class Engine:
                 self._head = None
                 req.stream._finish("cancelled")
                 continue
+            if (req.deadline_t is not None
+                    and time.monotonic() > req.deadline_t):
+                # Deadline expired while queued: the caller has already
+                # given up — prefilling it would burn a slot and device
+                # time on an answer nobody is waiting for. Dropped
+                # BEFORE any slot/page allocation; the stream finishes
+                # (empty) with the reason on its flight timeline.
+                self._head = None
+                self._bump("deadline_queue_drops")
+                tl = req.stream.timeline
+                if tl is not None:
+                    tl.stage("engine_admit_pickup",
+                             time.monotonic() - req.stream.submit_time)
+                req.stream._finish("deadline_queue")
+                continue
             n_alloc = _ceil_div(req.extent, self.cfg.page_size)
             # Shared-prefix match: map the longest cached block chain of
             # this prompt read-only (refs taken NOW so pool-pressure
@@ -2115,6 +2175,7 @@ class Engine:
                 tl.stage("engine_admit_pickup", qwait)
                 tl.annotate(slot=slot, pages_held=len(req.pages),
                             prefix_hit_tokens=start_tok)
+            faults.inject("engine.dispatch")  # chaos: slow/failed prefill
             t_dispatch = time.monotonic()
             # Masks/tables were built at submit() on the caller's thread
             # (overlapped with the queue wait) — the serve loop only
@@ -2219,6 +2280,7 @@ class Engine:
                           self._slots.values()), default=0)
         if need_steps <= 0:
             return False
+        faults.inject("engine.dispatch")  # chaos: slow/failed decode round
         # Right-size the final round: a power-of-two step ladder keeps the
         # compile count low while the tail of a generation doesn't pay for
         # a full round of masked steps.
@@ -2292,6 +2354,14 @@ class Engine:
 
         if req.stream.cancelled and finish is None:
             finish = "cancelled"
+        elif (finish is None and req.deadline_t is not None
+                and time.monotonic() > req.deadline_t):
+            # Deadline passed mid-generation: stop decoding now — the
+            # tokens already emitted stand, but nobody is waiting for
+            # more. Retired like a host-detected finish (the scheduler
+            # releases the slot on the device).
+            finish = "deadline"
+            self._bump("deadline_stops")
         elif finish != "eos":  # eos token itself is not emitted as text
             chunk = req.stop.feed(req.detok.push(token))
             req.stream._put_chunk(chunk)

@@ -13,15 +13,69 @@ the chain server's ``/debug/requests`` by the ID this client holds in
 
 from __future__ import annotations
 
+import json
 from typing import Generator, Optional
 
 import requests
 
 from ..obs.flight import mint_request_id
 from ..obs.tracing import inject_context
+from ..serving.client import post_with_retry
+from ..utils import faults
 from ..utils.logging import get_logger
 
 logger = get_logger(__name__)
+
+
+class ChainServerError(requests.HTTPError):
+    """A structured error body from the chain server's robustness layer
+    (``{"error": {"type", "message"}, "request_id"}`` + ``Retry-After``)
+    surfaced as typed fields instead of a bare status line — so callers
+    can honor the retry hint and tell ``queue_full`` from
+    ``deadline_unmeetable``. Subclasses requests.HTTPError, so existing
+    ``except requests.HTTPError`` handlers keep working."""
+
+    def __init__(self, message: str, *, response, err_type: str = "",
+                 request_id: str = "",
+                 retry_after_s: Optional[float] = None):
+        super().__init__(message, response=response)
+        self.err_type = err_type
+        self.request_id = request_id
+        self.retry_after_s = retry_after_s
+
+
+def raise_for_chain_status(resp: requests.Response) -> None:
+    """``raise_for_status`` that keeps the server's JSON error contract
+    intact when present (plain HTTPError otherwise)."""
+    if resp.status_code < 400:
+        return
+    err_type = msg = rid = ""
+    try:
+        body = resp.json()
+        err = body.get("error") or {}
+        err_type = str(err.get("type", ""))
+        msg = str(err.get("message", ""))
+        rid = str(body.get("request_id", ""))
+    except Exception:  # noqa: BLE001 — not a JSON error body
+        pass
+    retry_after: Optional[float] = None
+    ra = resp.headers.get("Retry-After", "")
+    try:
+        retry_after = float(ra) if ra else None
+    except ValueError:
+        pass
+    if msg:
+        raise ChainServerError(
+            f"HTTP {resp.status_code} {err_type or 'error'}: {msg}"
+            + (f" (request {rid})" if rid else ""),
+            response=resp, err_type=err_type, request_id=rid,
+            retry_after_s=retry_after)
+    resp.raise_for_status()
+
+# Mid-stream failure markers the chain server emits after a partial
+# answer: human-readable text, then a machine-readable event frame.
+ERROR_MARK = "\n[error]"
+ERROR_EVENT_MARK = "event: error\ndata:"
 
 
 class ChatClient:
@@ -33,56 +87,139 @@ class ChatClient:
         # Request ID of the most recent call — what to quote when asking
         # the chain server's /debug/requests why it was slow.
         self.last_request_id: Optional[str] = None
+        # Mid-stream failure of the most recent predict() call:
+        # {"message": ..., "error": ..., "request_id": ...} or None.
+        # The answer chunks predict() yielded remain valid partial
+        # output; this field says why they stopped.
+        self.last_error: Optional[dict] = None
 
     def _headers(self, request_id: Optional[str] = None) -> dict:
         rid = request_id or mint_request_id()
         self.last_request_id = rid
         return inject_context({"X-Request-ID": rid})
 
+    def _post(self, path: str, **kw) -> requests.Response:
+        # One retry policy for every outgoing call: serving.client's
+        # post_with_retry (connect-phase failures only, backoff+jitter,
+        # http.connect fault point per attempt).
+        return post_with_retry(f"{self.server_url}{path}", **kw)
+
     def search(self, prompt: str, num_docs: int = 4,
                request_id: Optional[str] = None) -> list[dict]:
         """Document retrieval (reference: chat_client.py:43)."""
-        resp = requests.post(
-            f"{self.server_url}/documentSearch",
+        resp = self._post(
+            "/documentSearch",
             json={"content": prompt, "num_docs": num_docs},
             headers=self._headers(request_id), timeout=self.timeout)
-        resp.raise_for_status()
+        raise_for_chain_status(resp)
         return resp.json()
 
     def predict(self, query: str, use_knowledge_base: bool = True,
                 num_tokens: int = 256, context: str = "",
                 request_id: Optional[str] = None,
+                on_error=None,
                 ) -> Generator[Optional[str], None, None]:
-        """Stream answer chunks; yields ``None`` when the stream ends
+        """Stream ANSWER chunks; yields ``None`` when the stream ends
         (reference: chat_client.py:72-99 — 16-byte chunk reads with a
-        final None sentinel)."""
+        final None sentinel).
+
+        Mid-stream failure frames (``\\n[error] ...`` and the trailing
+        ``event: error`` JSON event) are NOT yielded as answer text: the
+        error is parsed into ``self.last_error`` — and passed to the
+        ``on_error`` callback, which concurrent callers sharing one
+        client MUST use, since ``last_error`` is instance state another
+        in-flight predict() can overwrite — so the UI can show the
+        partial answer plus an explicit failure notice instead of
+        rendering the error as the model's words. Because the marker can
+        straddle the 16-byte chunk boundary, a marker-length tail is
+        held back until the next chunk disambiguates it."""
         import codecs
         decoder = codecs.getincrementaldecoder("utf-8")(errors="replace")
-        with requests.post(
-                f"{self.server_url}/generate",
-                json={"question": query, "context": context,
-                      "use_knowledge_base": use_knowledge_base,
-                      "num_tokens": num_tokens},
-                headers=self._headers(request_id), stream=True,
-                timeout=self.timeout) as resp:
-            resp.raise_for_status()
+        self.last_error = None
+        pending = ""       # undelivered text (holds back a marker-size tail)
+        error_tail = ""    # text after the error marker (never yielded)
+        in_error = False
+
+        def scan(flush: bool):
+            nonlocal pending, error_tail, in_error
+            if in_error:
+                return
+            idx = pending.find(ERROR_MARK)
+            if idx >= 0:
+                out, error_tail = pending[:idx], pending[idx:]
+                pending = ""
+                in_error = True
+                if out:
+                    yield out
+            elif flush:
+                out, pending = pending, ""
+                if out:
+                    yield out
+            else:
+                keep = len(ERROR_MARK) - 1
+                if len(pending) > keep:
+                    out, pending = pending[:-keep], pending[-keep:]
+                    if out:
+                        yield out
+
+        resp = self._post(
+            "/generate",
+            json={"question": query, "context": context,
+                  "use_knowledge_base": use_knowledge_base,
+                  "num_tokens": num_tokens},
+            headers=self._headers(request_id), stream=True,
+            timeout=self.timeout)
+        with resp:
+            raise_for_chain_status(resp)
             for chunk in resp.iter_content(chunk_size=16,
                                            decode_unicode=False):
                 # incremental decode: multi-byte UTF-8 sequences may
                 # straddle the 16-byte chunk boundary
                 text = decoder.decode(chunk)
-                if text:
-                    yield text
-        tail = decoder.decode(b"", final=True)
-        if tail:
-            yield tail
+                if not text:
+                    continue
+                if in_error:
+                    error_tail += text
+                else:
+                    pending += text
+                    yield from scan(flush=False)
+        pending += decoder.decode(b"", final=True)
+        yield from scan(flush=True)
+        if in_error:
+            err = self._parse_error(error_tail)
+            self.last_error = err
+            if on_error is not None:
+                on_error(err)
+            logger.warning("generation failed mid-stream (request %s): %s",
+                           self.last_request_id, err)
         yield None
+
+    def _parse_error(self, tail: str) -> dict:
+        """Structured error from the stream's error frames: the JSON
+        ``event: error`` payload when present, else the ``[error]``
+        text."""
+        idx = tail.find(ERROR_EVENT_MARK)
+        if idx >= 0:
+            payload = tail[idx + len(ERROR_EVENT_MARK):].strip()
+            try:
+                out = json.loads(payload.split("\n", 1)[0])
+                out.setdefault("request_id", self.last_request_id)
+                return out
+            except (json.JSONDecodeError, AttributeError):
+                pass
+        msg = tail[len(ERROR_MARK):].split("\n\nevent:")[0].strip()
+        return {"message": msg or "generation failed",
+                "request_id": self.last_request_id}
 
     def upload_documents(self, file_paths: list[str]) -> None:
         """Upload files into the knowledge base
         (reference: chat_client.py:101-127)."""
         for path in file_paths:
             with open(path, "rb") as f:
+                # No connect-retry here: the file handle is consumed by
+                # a failed send, and replaying a partially-read upload
+                # is not idempotent the way /generate connects are.
+                faults.inject("http.connect")
                 resp = requests.post(
                     f"{self.server_url}/uploadDocument",
                     files={"file": (path.split("/")[-1], f)},

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import json
 import os
 from typing import Optional
 
@@ -63,23 +64,43 @@ def create_app(client: ChatClient, asr=None, tts=None) -> web.Application:
         await resp.prepare(request)
 
         def chunks():
+            # Per-request error capture via callback: the shared
+            # ChatClient's last_error attribute can be overwritten by a
+            # concurrent request's predict() before we'd read it.
+            errs: list = []
             for chunk in client.predict(
                     body.get("question", ""),
                     use_knowledge_base=bool(body.get("use_knowledge_base", True)),
                     num_tokens=int(body.get("num_tokens", 256)),
-                    context=body.get("context", "")):
+                    context=body.get("context", ""),
+                    on_error=errs.append):
                 if chunk is None:
+                    # predict() filtered any mid-stream error frames out
+                    # of the answer text; hand the parsed failure (if
+                    # any) to the async side as a typed item.
+                    if errs:
+                        yield ("__error__", dict(errs[-1]))
                     return
                 yield chunk
 
         try:
             async for chunk in iterate_in_thread(chunks()):
+                if isinstance(chunk, tuple):
+                    # Partial answer + failure: forward the failure as a
+                    # machine-readable event frame, NOT as answer text.
+                    _, err = chunk
+                    await resp.write(
+                        ("\n\nevent: error\ndata: "
+                         + json.dumps(err) + "\n\n").encode())
+                    continue
                 await resp.write(chunk.encode("utf-8"))
         except (ConnectionResetError, ConnectionError):
             pass
         except Exception as exc:  # noqa: BLE001 — surface to the UI
             logger.exception("proxy generate failed")
-            await resp.write(f"\n[error] {exc}".encode())
+            await resp.write(
+                ("\n\nevent: error\ndata: "
+                 + json.dumps({"message": str(exc)}) + "\n\n").encode())
         await resp.write_eof()
         return resp
 

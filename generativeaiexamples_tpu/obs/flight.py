@@ -85,6 +85,29 @@ def adopt_request_id(headers: Any, mint=mint_request_id) -> str:
     return rid or mint()
 
 
+def adopt_deadline_ms(headers: Any,
+                      default_ms: Optional[float] = None) -> Optional[float]:
+    """Per-request deadline from the ``X-Deadline-Ms`` header: how long
+    the caller is willing to wait for this request END TO END. Returns
+    milliseconds, or None when neither the header nor ``default_ms``
+    sets a positive bound. Malformed values fall back to the default —
+    a garbled header must not grant an infinite deadline when the
+    deployment configured a finite one."""
+    ms: Optional[float] = None
+    if headers is not None:
+        raw = (headers.get("X-Deadline-Ms") or "").strip()
+        if raw:
+            try:
+                ms = float(raw)
+            except ValueError:
+                ms = None
+    if ms is None:
+        ms = default_ms
+    if ms is None or ms <= 0:
+        return None
+    return ms
+
+
 def bind(timeline: Optional["Timeline"]):
     """Bind ``timeline`` as the current request's; returns the reset
     token for ``unbind``."""
@@ -128,7 +151,7 @@ class Timeline:
     """
 
     __slots__ = ("request_id", "t_start", "wall_start", "meta", "done",
-                 "otel_ctx", "_events", "_cap", "_seq", "_n")
+                 "otel_ctx", "deadline_t", "_events", "_cap", "_seq", "_n")
 
     def __init__(self, request_id: str, event_cap: int = 64):
         self.request_id = request_id
@@ -136,6 +159,11 @@ class Timeline:
         self.wall_start = time.time()
         self.meta: dict[str, Any] = {}
         self.done = False
+        # Absolute (monotonic) deadline for this request, set at the
+        # serving edge from X-Deadline-Ms / the configured default.
+        # The engine adopts it through the same contextvar as the
+        # request ID — queue drops and mid-decode stops key off it.
+        self.deadline_t: Optional[float] = None
         # OTel context captured at begin() (the request's server span)
         # so the retrospective span replay parents engine stages INTO
         # the request's trace instead of emitting disconnected roots.
@@ -161,6 +189,17 @@ class Timeline:
 
     def annotate(self, **fields: Any) -> None:
         self.meta.update(fields)
+
+    def set_deadline(self, ms: Optional[float]) -> None:
+        """Arm this request's deadline, ``ms`` from its start (None/<=0
+        clears). Recorded in meta so /debug/requests shows the budget a
+        dropped request was admitted against."""
+        if ms is None or ms <= 0:
+            self.deadline_t = None
+            self.meta.pop("deadline_ms", None)
+            return
+        self.deadline_t = self.t_start + ms / 1e3
+        self.meta["deadline_ms"] = round(float(ms), 1)
 
     # ------------------------------------------------------------ readers
 
@@ -333,6 +372,31 @@ class FlightRecorder:
                 if tl.request_id == request_id:
                     return tl
         return None
+
+    def recent_stage_ms(self, name: str, limit: int = 32,
+                        window_s: float = 60.0) -> tuple[int, float]:
+        """``(samples, avg_ms)`` of stage ``name`` over the most recently
+        completed timelines — the data behind edge admission control: the
+        chain server estimates a new request's queue wait from the
+        ``engine_admit_pickup`` durations of the last N requests and
+        sheds arrivals whose deadline the estimate already exceeds.
+        ``window_s`` bounds how STALE the evidence may be: without it, a
+        past congestion burst would keep shedding requests long after
+        the queue drained idle (no completions → the ring never turns
+        over). Cheap by construction: reads only the bounded ring."""
+        now = time.monotonic()
+        with self._lock:
+            tls = list(self._completed)[-max(0, int(limit)):]
+        vals = []
+        for tl in tls:
+            if window_s and now - tl.t_start > window_s:
+                continue
+            d = tl.stage_durations().get(name)
+            if d is not None:
+                vals.append(d * 1e3)
+        if not vals:
+            return 0, 0.0
+        return len(vals), sum(vals) / len(vals)
 
     def snapshot(self, limit: int = 50) -> dict:
         """JSON-ready view for ``/debug/requests``: every in-flight

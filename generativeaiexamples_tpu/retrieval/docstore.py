@@ -15,6 +15,9 @@ from typing import Any, Optional, Sequence
 
 import numpy as np
 
+from ..utils import faults
+from ..utils.errors import RetrievalError
+from ..utils.resilience import get_breaker
 from .store import VectorStore, get_vector_store
 
 
@@ -55,13 +58,46 @@ class DocumentIndex:
              for t, m in zip(texts, metadatas)])
 
     def similarity_search(self, query: str, k: int = 4) -> list[Document]:
-        """Top-k documents for a text query (embedder's query mode)."""
+        """Top-k documents for a text query (embedder's query mode).
+
+        Both external dependencies — the embedder and the vector store —
+        run under named circuit breakers (utils/resilience.py): after
+        repeated failures the breaker opens and this raises
+        ``BreakerOpenError`` in microseconds instead of stalling on a
+        dead backend. Raw backend exceptions (a down Milvus, a pgvector
+        connection reset, an injected fault) are wrapped in
+        ``RetrievalError`` with ``reason`` set to the failing dependency,
+        so chains can degrade to their LLM-only path and label the
+        fallback. ``BreakerOpenError`` passes through untouched (it
+        already carries the breaker name)."""
         from ..obs.tracing import event_span
+        from ..utils.errors import BreakerOpenError
+
+        def _embed():
+            faults.inject("embed")
+            return np.asarray(self.embedder.embed_query(query), np.float32)
+
+        def _search(q):
+            faults.inject("retrieval.search")
+            return self.store.search(q, k=k)
+
         if len(self.store) == 0:
             return []
-        with event_span("embedding", mode="query", chars=len(query)):
-            q = np.asarray(self.embedder.embed_query(query), np.float32)
-        hits = self.store.search(q, k=k)[0]
+        try:
+            with event_span("embedding", mode="query", chars=len(query)):
+                q = get_breaker("embed").call(_embed)
+        except BreakerOpenError:
+            raise
+        except Exception as exc:  # noqa: BLE001 — typed for degradation
+            raise RetrievalError(f"query embedding failed: {exc}",
+                                 reason="embed") from exc
+        try:
+            hits = get_breaker("retrieval").call(_search, q)[0]
+        except BreakerOpenError:
+            raise
+        except Exception as exc:  # noqa: BLE001 — typed for degradation
+            raise RetrievalError(f"vector search failed: {exc}",
+                                 reason="retrieval") from exc
         out = []
         for hit in hits:
             doc = self._docs.get(hit.id)

@@ -18,11 +18,56 @@ from typing import Iterator, Optional
 
 import requests
 
+from ..utils import faults
 from ..utils.errors import FrameworkError
+from ..utils.resilience import retry_call
 
 
 class ServerNotReadyError(FrameworkError):
     pass
+
+
+# Connection-level failures only: the request never reached the server,
+# so a bounded backoff-with-jitter replay is safe — the X-Request-ID
+# each call carries keeps the server-side flight record coherent across
+# the retries. Read timeouts/HTTP errors are NOT retried here; the
+# caller decides those.
+RETRYABLE = (requests.exceptions.ConnectionError,
+             requests.exceptions.ConnectTimeout, ConnectionError)
+
+
+def is_connect_failure(exc: BaseException) -> bool:
+    """True only when the failure happened ESTABLISHING the connection —
+    the request cannot have been executed server-side, so a replay
+    cannot double-run a generation. requests.ConnectionError also wraps
+    mid-response resets (RemoteDisconnected, ConnectionResetError) where
+    the server may have done the work; those must NOT be replayed."""
+    if isinstance(exc, (requests.exceptions.ConnectTimeout,
+                        ConnectionRefusedError)):
+        return True
+    if isinstance(exc, ConnectionError):  # builtin (incl. injected faults)
+        # subclasses Reset/Aborted/BrokenPipe mean bytes were in flight
+        return type(exc) is ConnectionError
+    if isinstance(exc, requests.exceptions.ConnectionError):
+        text = repr(exc)
+        return ("NewConnectionError" in text
+                or "Failed to establish" in text
+                or "Connection refused" in text
+                or "Name or service not known" in text
+                or "Temporary failure in name resolution" in text)
+    return False
+
+
+def post_with_retry(url: str, **kw) -> requests.Response:
+    """``requests.post`` with bounded exponential-backoff retry (full
+    jitter) on connect-phase failures only (``is_connect_failure``); the
+    ``http.connect`` fault point fires per attempt so chaos plans can
+    exercise the backoff path."""
+    def _connect():
+        faults.inject("http.connect")
+        return requests.post(url, **kw)
+    return retry_call(_connect, retry_on=RETRYABLE,
+                      should_retry=is_connect_failure)
 
 
 class TritonShimClient:
@@ -66,7 +111,7 @@ class TritonShimClient:
                  top_p: float = 0.0, repetition_penalty: float = 1.0,
                  random_seed: int = 0,
                  stop_words: Optional[list[str]] = None) -> str:
-        resp = requests.post(
+        resp = post_with_retry(
             f"{self.base}/v2/models/{self.model_name}/generate",
             json=self._body(prompt, max_tokens, temperature, top_k, top_p,
                             repetition_penalty, random_seed, stop_words),
@@ -83,7 +128,7 @@ class TritonShimClient:
         """Yield text deltas until the final-response flag
         (parity: the decoupled stream callback checks
         ``triton_final_response``, trt_llm.py:417-442)."""
-        with requests.post(
+        with post_with_retry(
                 f"{self.base}/v2/models/{self.model_name}/generate_stream",
                 json=self._body(prompt, max_tokens, temperature, top_k,
                                 top_p, repetition_penalty, random_seed,
@@ -111,20 +156,20 @@ class OpenAIClient:
 
     def complete(self, prompt: str, **kw) -> str:
         body = {"model": self.model, "prompt": prompt, **kw}
-        resp = requests.post(f"{self.base}/v1/completions", json=body,
-                             timeout=self.timeout)
+        resp = post_with_retry(f"{self.base}/v1/completions", json=body,
+                              timeout=self.timeout)
         resp.raise_for_status()
         return resp.json()["choices"][0]["text"]
 
     def chat(self, messages: list[dict], **kw) -> str:
         body = {"model": self.model, "messages": messages, **kw}
-        resp = requests.post(f"{self.base}/v1/chat/completions", json=body,
-                             timeout=self.timeout)
+        resp = post_with_retry(f"{self.base}/v1/chat/completions",
+                               json=body, timeout=self.timeout)
         resp.raise_for_status()
         return resp.json()["choices"][0]["message"]["content"]
 
     def embed(self, texts: list[str], input_type: str = "query") -> list[list[float]]:
-        resp = requests.post(
+        resp = post_with_retry(
             f"{self.base}/v1/embeddings",
             json={"input": texts, "input_type": input_type},
             timeout=self.timeout)

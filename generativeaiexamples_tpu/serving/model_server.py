@@ -315,7 +315,14 @@ def create_server_app(engine, embed_service=None,
     # POST /profiler/stop -> trace written for TensorBoard/XProf.
     profiler_state = {"dir": None}
 
+    # Profiler start/stop run OFF the event loop with a bound: a wedged
+    # jax.profiler (seen hanging in stop_trace on some CPU builds) must
+    # cost the caller a 504, not freeze every other endpoint on this
+    # server's single event loop forever.
+    profiler_timeout_s = float(os.environ.get("PROFILER_TIMEOUT_S", "120"))
+
     async def profiler_start(request: web.Request) -> web.Response:
+        import asyncio
         import jax
         try:
             body = await request.json()
@@ -330,17 +337,47 @@ def create_server_app(engine, embed_service=None,
         profiler_state["dir"] = trace_dir
         try:
             os.makedirs(trace_dir, exist_ok=True)
-            jax.profiler.start_trace(trace_dir)
+            await asyncio.wait_for(
+                asyncio.get_running_loop().run_in_executor(
+                    None, jax.profiler.start_trace, trace_dir),
+                timeout=profiler_timeout_s)
+        except asyncio.TimeoutError:
+            # The executor thread may still complete the start later —
+            # KEEP the claim, or the state would desync (jax tracing
+            # while this server believes it is not). A later
+            # /profiler/stop clears it either way.
+            raise web.HTTPGatewayTimeout(
+                text=f"profiler start exceeded {profiler_timeout_s}s; "
+                     f"trace state unknown — POST /profiler/stop to "
+                     f"clean up")
         except Exception:
             profiler_state["dir"] = None
             raise
         return web.json_response({"status": "tracing", "dir": trace_dir})
 
     async def profiler_stop(request: web.Request) -> web.Response:
+        import asyncio
         import jax
         if not profiler_state["dir"]:
             raise web.HTTPConflict(text="profiler not running")
-        jax.profiler.stop_trace()
+        try:
+            await asyncio.wait_for(
+                asyncio.get_running_loop().run_in_executor(
+                    None, jax.profiler.stop_trace),
+                timeout=profiler_timeout_s)
+        except asyncio.TimeoutError:
+            # Keep the claim: the stop may still land on its executor
+            # thread, and the operator can retry — clearing it here
+            # would let a new start_trace race the wedged stop.
+            raise web.HTTPGatewayTimeout(
+                text=f"profiler stop exceeded {profiler_timeout_s}s; "
+                     f"retry to attempt cleanup")
+        except Exception as exc:  # noqa: BLE001 — e.g. "no profile running"
+            # jax says there is nothing to stop (a timed-out start that
+            # never engaged): reconcile our claim with reality.
+            profiler_state["dir"] = None
+            raise web.HTTPConflict(
+                text=f"profiler stop failed: {exc}") from exc
         trace_dir, profiler_state["dir"] = profiler_state["dir"], None
         return web.json_response({"status": "written", "dir": trace_dir})
 

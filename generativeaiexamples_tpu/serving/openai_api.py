@@ -12,6 +12,8 @@ from __future__ import annotations
 
 import asyncio
 import json
+import math
+import os
 import time
 import uuid
 from typing import Optional
@@ -21,7 +23,19 @@ from aiohttp import web
 from ..engine.sampling_params import SamplingParams
 from ..obs import metrics as obs_metrics
 from ..obs.tracing import instrumented
+from ..utils.errors import SchedulerFullError
 from .streaming import iterate_in_thread
+
+
+def _openai_error(status: int, err_type: str, message: str,
+                  retry_after_s: Optional[float] = None) -> web.Response:
+    """OpenAI-shaped error body; ``Retry-After`` on retryable statuses."""
+    headers = {}
+    if retry_after_s is not None:
+        headers["Retry-After"] = str(max(1, int(math.ceil(retry_after_s))))
+    return web.json_response(
+        {"error": {"type": err_type, "message": message, "code": status}},
+        status=status, headers=headers)
 
 
 def _sampling_from_body(body: dict, max_output: int) -> SamplingParams:
@@ -113,6 +127,15 @@ def add_openai_routes(app: web.Application, engine, model_name: str,
         from ..obs import flight as obs_flight
         rid = obs_flight.adopt_request_id(
             request.headers, mint=lambda: f"cmpl-{uuid.uuid4().hex[:24]}")
+        # Per-request deadline (X-Deadline-Ms, env default): passed to
+        # the engine EXPLICITLY — run_in_executor does not propagate the
+        # contextvar the chain server rides — so queued-past-deadline
+        # requests drop before prefill and decode stops when it passes.
+        deadline_ms = obs_flight.adopt_deadline_ms(
+            request.headers,
+            float(os.environ.get("REQUEST_DEADLINE_MS", "0") or 0) or None)
+        deadline_t = (time.monotonic() + deadline_ms / 1e3
+                      if deadline_ms is not None else None)
         created = int(time.time())
         timer = obs_metrics.RequestTimer(f"serve_{kind}")
 
@@ -123,9 +146,15 @@ def add_openai_routes(app: web.Application, engine, model_name: str,
             # other in-flight requests on this single-threaded server.
             stream = await loop.run_in_executor(
                 None, lambda: engine.stream_text(prompt, params,
-                                                 request_id=rid))
+                                                 request_id=rid,
+                                                 deadline_t=deadline_t))
+        except SchedulerFullError as exc:
+            # Overload is a 429 with a retry hint, not a 503: the engine
+            # is alive, its admission queue is full.
+            return _openai_error(429, "rate_limit_error", str(exc),
+                                 retry_after_s=1.0)
         except Exception as exc:  # noqa: BLE001
-            raise web.HTTPServiceUnavailable(text=str(exc)) from exc
+            return _openai_error(503, "service_unavailable", str(exc))
         # The response id must BE the timeline key: a duplicate
         # in-flight X-Request-ID gets a '#N'-suffixed timeline, and the
         # client must receive the id that /debug/requests answers to.
@@ -134,7 +163,8 @@ def add_openai_routes(app: web.Application, engine, model_name: str,
         if body.get("stream"):
             resp = web.StreamResponse(
                 headers={"Content-Type": "text/event-stream",
-                         "Cache-Control": "no-cache"})
+                         "Cache-Control": "no-cache",
+                         "X-Request-ID": rid})
             await resp.prepare(request)
             try:
                 async for chunk in iterate_in_thread(iter(stream), on_cancel=stream.cancel):

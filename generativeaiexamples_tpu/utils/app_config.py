@@ -130,6 +130,36 @@ class EngineConfig:
 
 
 @dataclass(frozen=True)
+class ServingRobustnessConfig:
+    """Deadline/overload behavior at the HTTP edges (chain server and
+    OpenAI API). Env overlay: ``APP_SERVING_*`` (configuration.py)."""
+    default_deadline_ms: float = configfield(
+        "default_deadline_ms", default=0.0,
+        help_txt="deadline applied when no X-Deadline-Ms header is sent "
+                 "(0 = none)")
+    request_timeout_s: float = configfield(
+        "request_timeout_s", default=30.0,
+        help_txt="executor timeout for documentSearch; a hung store "
+                 "returns 504 instead of pinning a worker")
+    ingest_timeout_s: float = configfield(
+        "ingest_timeout_s", default=300.0,
+        help_txt="executor timeout for uploadDocument ingest — separate "
+                 "knob: chunking+embedding a large file is legitimately "
+                 "slow where a search is not")
+    breaker_failures: int = configfield(
+        "breaker_failures", default=5,
+        help_txt="consecutive generate failures before the engine "
+                 "breaker opens (fast-503)")
+    breaker_cooldown_s: float = configfield(
+        "breaker_cooldown_s", default=15.0,
+        help_txt="seconds an open breaker waits before a half-open probe")
+    admission_min_samples: int = configfield(
+        "admission_min_samples", default=4,
+        help_txt="completed requests needed before queue-wait-based "
+                 "admission shedding activates")
+
+
+@dataclass(frozen=True)
 class TracingConfig:
     enabled: bool = configfield("enabled", default=False,
                                 help_txt="enable OpenTelemetry tracing (reference gates on ENABLE_TRACING)")
@@ -148,6 +178,8 @@ class AppConfig:
     mesh: MeshConfig = field(default_factory=MeshConfig)
     engine: EngineConfig = field(default_factory=EngineConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)
+    serving: ServingRobustnessConfig = field(
+        default_factory=ServingRobustnessConfig)
 
 
 _CONFIG_SINGLETON: AppConfig | None = None

@@ -40,7 +40,24 @@ class SchedulerFullError(EngineError):
 
 
 class RetrievalError(FrameworkError):
-    """Vector-store failure."""
+    """Vector-store failure. ``reason`` labels which dependency failed
+    (``retrieval`` / ``embed``) for degradation metrics."""
+
+    def __init__(self, *args, reason: str = "retrieval"):
+        super().__init__(*args)
+        self.reason = reason
+
+
+class BreakerOpenError(FrameworkError):
+    """A circuit breaker rejected the call without attempting it
+    (utils/resilience.py). Carries the breaker's name and the cooldown
+    remaining so edges can emit ``Retry-After`` and degradation paths
+    can label their fallback."""
+
+    def __init__(self, *args, breaker: str = "", retry_after_s: float = 0.0):
+        super().__init__(*args)
+        self.breaker = breaker
+        self.retry_after_s = retry_after_s
 
 
 class ChainError(FrameworkError):

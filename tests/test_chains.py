@@ -232,7 +232,10 @@ def test_server_upload_generate_search(tmp_path):
     _run(_with_client(fn))
 
 
-def test_server_error_degrades_to_stream_message():
+def test_server_pre_stream_error_is_real_http_status():
+    """A failure BEFORE the first generated chunk is a real 500 with a
+    JSON body + X-Request-ID — not a 200 SSE carrying '[error]' text
+    (docs/robustness.md error taxonomy)."""
     class BrokenExample(BaseExample):
         def llm_chain(self, context, question, num_tokens):
             raise RuntimeError("boom")
@@ -250,8 +253,47 @@ def test_server_error_degrades_to_stream_message():
         try:
             resp = await client.post("/generate", json={
                 "question": "x", "num_tokens": 10})
+            assert resp.status == 500
+            assert resp.headers.get("X-Request-ID")
+            body = await resp.json()
+            assert "boom" in body["error"]["message"]
+            assert body["request_id"] == resp.headers["X-Request-ID"]
+        finally:
+            await client.close()
+    _run(fn())
+
+
+def test_server_mid_stream_error_degrades_with_event():
+    """After chunks have gone out on the 200, a failure keeps the
+    in-stream degrade ('[error]' text) and appends a machine-readable
+    final event frame."""
+    class HalfBrokenExample(BaseExample):
+        def llm_chain(self, context, question, num_tokens):
+            yield "partial "
+            yield "answer"
+            raise RuntimeError("mid boom")
+
+        def rag_chain(self, prompt, num_tokens):
+            yield from self.llm_chain("", prompt, num_tokens)
+
+        def ingest_docs(self, data_dir, filename):
+            pass
+
+    async def fn():
+        app = create_app(HalfBrokenExample())
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            resp = await client.post("/generate", json={
+                "question": "x", "num_tokens": 10})
+            assert resp.status == 200
             body = (await resp.read()).decode()
-            assert "[error]" in body  # reference: server.py:136-142
+            assert body.startswith("partial answer")
+            assert "[error] mid boom" in body
+            event = body.split("event: error\ndata:", 1)[1].strip()
+            payload = json.loads(event.split("\n", 1)[0])
+            assert payload["message"] == "mid boom"
+            assert payload["request_id"] == resp.headers["X-Request-ID"]
         finally:
             await client.close()
     _run(fn())
