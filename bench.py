@@ -340,6 +340,120 @@ def run_chat_bench(engine, n_turns: int = 6, system_len: int = 512,
     }
 
 
+def run_openloop_bench(engine, *, rates, duration_s=10.0, slo_ttft_ms=500.0,
+                       deadline_ms=2000.0, prompt_median=256,
+                       prompt_sigma=0.6, out_len=32, seed=0):
+    """Open-loop Poisson-arrival scenario: SLO attainment and goodput
+    under OFFERED load, the production-shaped metric the closed-loop
+    p50 scenarios cannot produce (a closed loop self-throttles to the
+    engine's pace; millions of users do not).
+
+    Per swept rate in ``rates`` (requests/sec): arrivals follow a
+    Poisson process (exponential inter-arrival times), prompt lengths a
+    LOGNORMAL mix around ``prompt_median`` (the chat-traffic shape: many
+    short, a heavy tail of long — exactly what the token-budget
+    scheduler interleaves), and every request carries a deadline of
+    ``deadline_ms``. Submission never waits for completions — overload
+    shows up as shed 429s, ``deadline_queue`` drops, and blown TTFTs
+    instead of a silently stretched run.
+
+    Headline per rate: **slo_attainment** (fraction of OFFERED requests
+    whose first token beat ``slo_ttft_ms`` AND whose generation finished
+    normally before its deadline) and **goodput_tokens_per_sec** (tokens
+    from SLO-met requests only, over the rate's wall window — work that
+    arrived too late to matter does not count).
+
+    Deterministic per ``seed``; leading prompt tokens are unique per
+    request so every admission is a cold prefill (warm-path TTFT is the
+    chat scenario's metric, not this one's).
+    """
+    import numpy as _np
+
+    from generativeaiexamples_tpu.engine import SamplingParams
+    from generativeaiexamples_tpu.utils.errors import SchedulerFullError
+
+    max_in = engine.cfg.max_input_length
+    sp = SamplingParams(max_tokens=out_len, top_k=1, ignore_eos=True)
+    out = {
+        "arrival_rps_sweep": [float(r) for r in rates],
+        "duration_s": float(duration_s),
+        "slo_ttft_ms": float(slo_ttft_ms),
+        "deadline_ms": float(deadline_ms) if deadline_ms else None,
+        "prompt_len_median": int(prompt_median),
+        "prompt_len_sigma": float(prompt_sigma),
+        "output_len": int(out_len),
+        "rates": [],
+    }
+    engine.start()
+    uid = 0   # unique per submission ACROSS rates — see prompt below
+    for rate in rates:
+        rng = _np.random.RandomState(seed)
+        n = max(1, int(rate * duration_s))
+        gaps = rng.exponential(1.0 / rate, size=n)
+        lens = _np.clip(rng.lognormal(_np.log(prompt_median), prompt_sigma,
+                                      size=n).astype(int), 4, max_in)
+        streams, shed = [], 0
+        t_start = time.monotonic()
+        next_t = t_start
+        for i in range(n):
+            next_t += gaps[i]
+            delay = next_t - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            # The 3-token head is unique per submission across the WHOLE
+            # sweep (125^3 ≈ 1.9M, far past any realistic rps×duration),
+            # not just within one rate: prefix-cache block hashes chain
+            # from block 0, so differing heads keep every admission a
+            # cold prefill — identical prompts would let a later rate
+            # ride an earlier rate's warm pages and measure warm TTFTs
+            # against the first rate's cold ones.
+            prompt = [4 + (uid % 125), 130 + ((uid // 125) % 125),
+                      4 + ((uid // 15625) % 125)] \
+                + [3 + (j % 251) for j in range(int(lens[i]) - 3)]
+            uid += 1
+            deadline_t = (time.monotonic() + deadline_ms / 1e3
+                          if deadline_ms else None)
+            try:
+                streams.append(engine.submit(prompt, sp,
+                                             deadline_t=deadline_t))
+            except SchedulerFullError:
+                shed += 1   # open loop: the 429 IS the datapoint
+        # Drain: every accepted stream terminates on its own (deadline
+        # enforcement guarantees it); .text() just joins them.
+        for s in streams:
+            try:
+                s.text()
+            except Exception:  # noqa: BLE001 — errored streams counted below
+                pass
+        elapsed = time.monotonic() - t_start
+        offered = n
+        deadline_drops = sum(1 for s in streams
+                             if s.finish_reason == "deadline_queue")
+        completed = sum(1 for s in streams
+                        if s.finish_reason in ("eos", "length", "stop"))
+        met = [s for s in streams
+               if s.finish_reason in ("eos", "length", "stop")
+               and s.ttft_ms is not None and s.ttft_ms <= slo_ttft_ms]
+        good_tokens = sum(len(s.token_ids) for s in met)
+        ttfts = sorted(s.ttft_ms for s in streams if s.ttft_ms is not None)
+        out["rates"].append({
+            "arrival_rps": float(rate),
+            "offered": offered,
+            "completed": completed,
+            "shed": shed,
+            "deadline_drops": deadline_drops,
+            "slo_attainment": round(len(met) / offered, 4),
+            "goodput_tokens_per_sec": round(good_tokens / elapsed, 1),
+            "ttft_p50_ms": (round(ttfts[len(ttfts) // 2], 2)
+                            if ttfts else None),
+            "ttft_p99_ms": (round(
+                ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.99))], 2)
+                if ttfts else None),
+            "tokens_total": sum(len(s.token_ids) for s in streams),
+        })
+    return out
+
+
 def pipeline_snapshot(stats: dict) -> dict:
     """Overlapped harvest/dispatch pipeline summary from engine.stats:
     how long the harvest worker blocked per round/first readback — time
@@ -370,7 +484,7 @@ def assemble_result(*, kind, model, headline, engine_p50, engine_p99, tput,
                     e2e_dist, e2e_breakdown, pipeline, quant, kv_quant,
                     weights, prompt_len, out_len, slots, steps_per_round,
                     kv_pool_pages, device, rtt_ms, n_devices,
-                    bench_seconds, e2e_tps_p50=None) -> dict:
+                    bench_seconds, e2e_tps_p50=None, openloop=None) -> dict:
     """The bench's single output contract. Every field name here is
     pinned by tools/bench_schema.json (validated at emit time AND by the
     tier-1 suite, tests/test_bench_schema.py) so a rename fails fast
@@ -403,6 +517,11 @@ def assemble_result(*, kind, model, headline, engine_p50, engine_p99, tput,
         # Harvest/dispatch overlap: the readback wait now runs on the
         # harvest worker, concurrent with dispatch (pipeline_snapshot)
         "engine_pipeline": pipeline,
+        # Open-loop Poisson-arrival scenario (BENCH_ARRIVAL_RPS sweep):
+        # SLO attainment + goodput under offered load — null when the
+        # sweep is not requested (closed-loop-only runs keep their
+        # existing shape)
+        "openloop": openloop,
         "quantization": quant,
         "kv_quant": kv_quant,
         "weights": weights,
@@ -745,6 +864,33 @@ def main() -> None:
                     run_e2e_bench(engine, embedder, max(3, n_requests))
             except Exception as exc:  # noqa: BLE001
                 sys.stderr.write(f"bench: e2e failed: {exc}\n")
+        # Open-loop goodput sweep: only when BENCH_ARRIVAL_RPS names the
+        # offered rates (comma-separated requests/sec). Runs LAST — its
+        # overload shedding would pollute the closed-loop numbers above.
+        openloop = None
+        rps_env = os.environ.get("BENCH_ARRIVAL_RPS", "")
+        if rps_env:
+            try:
+                openloop = run_openloop_bench(
+                    engine,
+                    rates=[float(r) for r in rps_env.split(",") if r],
+                    duration_s=float(os.environ.get(
+                        "BENCH_OPENLOOP_SECONDS", "10")),
+                    slo_ttft_ms=float(os.environ.get(
+                        "BENCH_SLO_TTFT_MS", "500")),
+                    deadline_ms=float(os.environ.get(
+                        "BENCH_OPENLOOP_DEADLINE_MS", "2000")),
+                    prompt_median=int(os.environ.get(
+                        "BENCH_OPENLOOP_PROMPT_MEDIAN",
+                        str(min(256, prompt_len)))),
+                    prompt_sigma=float(os.environ.get(
+                        "BENCH_OPENLOOP_PROMPT_SIGMA", "0.6")),
+                    out_len=int(os.environ.get(
+                        "BENCH_OPENLOOP_OUT", str(min(32, out_len)))),
+                    seed=int(os.environ.get("BENCH_SEED", "0")))
+            except Exception as exc:  # noqa: BLE001
+                sys.stderr.write(f"bench: open-loop scenario failed: "
+                                 f"{exc}\n")
         # Cumulative over every scenario above — the overlap summary is
         # about pipeline behavior, not one workload's magnitude.
         pipeline = pipeline_snapshot(engine.stats)
@@ -763,7 +909,7 @@ def main() -> None:
         achieved_bw=achieved_bw, bw_util=bw_util, bw_steady=bw_steady,
         chat=chat, e2e_p50=e2e_p50, e2e_dist=e2e_dist,
         e2e_breakdown=e2e_breakdown, e2e_tps_p50=e2e_tps_p50,
-        pipeline=pipeline,
+        pipeline=pipeline, openloop=openloop,
         quant=quant, kv_quant=engine.cfg.kv_quant or None,
         weights=("real" if os.environ.get("BENCH_MODEL_PATH")
                  else "random-init"),

@@ -44,6 +44,7 @@ model_server/server.py:67-71). Architecture:
 from __future__ import annotations
 
 import itertools
+import os
 import queue
 import threading
 import time
@@ -68,6 +69,7 @@ from ..utils.errors import ConfigError, EngineError, SchedulerFullError
 from .detokenizer import IncrementalDetokenizer, StopChecker
 from .prefix_cache import PrefixCache, hash_blocks, usable_prefix_tokens
 from .sampling_params import SamplingParams
+from .scheduler import PrefillJob, StepCostModel, TokenBudgetScheduler
 
 
 def _ceil_div(a: int, b: int) -> int:
@@ -98,6 +100,15 @@ _STATS_TEMPLATE = {
     "rejected_full": 0,
     "deadline_queue_drops": 0,
     "deadline_stops": 0,
+    # Token-budget scheduler (engine/scheduler.py): the resolved
+    # per-round budget, cumulative prefill tokens it granted as chunks,
+    # cumulative decode token-equivalents charged against it, and how
+    # many rounds actually mixed a decode dispatch with prefill chunks
+    # (the interleaving the budget exists to enable).
+    "sched_round_budget_tokens": 0,
+    "sched_prefill_tokens": 0,
+    "sched_decode_tokens": 0,
+    "sched_interleaved_rounds": 0,
 }
 
 
@@ -107,7 +118,8 @@ def engine_stat_keys() -> tuple[str, ...]:
     counters (prefix caching is on by default). The single source of
     truth tools/check_metrics_docs.py checks the docs against."""
     from .prefix_cache import CacheStats
-    return (tuple(_STATS_TEMPLATE) + ("dispatch_queue_depth",)
+    return (tuple(_STATS_TEMPLATE)
+            + ("dispatch_queue_depth", "sched_prefill_share")
             + tuple(CacheStats().snapshot()) + ("prefix_cache_pages",))
 
 
@@ -198,6 +210,14 @@ class EngineConfig:
     # back dequantized, so a warm request tracks (not bit-matches) the
     # cold trajectory — same caveat as chunked long-prompt admission.
     prefix_cache: bool = True
+    # Token-budget continuous scheduler (engine/scheduler.py): per-round
+    # prefill-token budget and per-request chunk cap. None = derive the
+    # budget from the PROFILE_rNN step-cost model (prefill tokens whose
+    # modeled cost equals one decode round) and let the chunk cap follow
+    # the budget. SCHED_ROUND_BUDGET_TOKENS / SCHED_PREFILL_CHUNK_TOKENS
+    # env vars override either (docs/configuration.md).
+    sched_round_budget_tokens: Optional[int] = None
+    sched_prefill_chunk_tokens: Optional[int] = None
 
     def __post_init__(self) -> None:
         # Geometry validation lives on the config, not the engine — a bad
@@ -363,7 +383,7 @@ class _Request:
     # they stay resident, warm for the next shared-prefix request).
     cache_refs: list = field(default_factory=list)
     cache_pages: set = field(default_factory=set)
-    block_hashes: Optional[list] = None  # memoized across _admit retries
+    block_hashes: Optional[list] = None  # memoized across admission retries
     proj_pos: int = 0         # host upper bound on the device-side pos
     generated: int = 0
     greedy: bool = False      # top_k==1 / temp<=0: argmax fast path
@@ -385,6 +405,16 @@ class _Request:
     # prefill (finish deadline_queue); passed mid-decode → stopped at
     # the next harvested token (finish deadline).
     deadline_t: Optional[float] = None
+    # Token-budget scheduler bookkeeping: arrival order (slack-sort
+    # tiebreak), whether the slot is armed for decode (False while
+    # prefill chunks are still in flight across rounds), the next
+    # prompt token to prefill, and the admission-time dispatch context
+    # (page row, window, masks, RNG key, prefix-cache seed) the chunk
+    # dispatches share — built once at _begin_prefill.
+    seq: int = 0
+    prefill_done: bool = False
+    pf_pos: int = 0
+    pf: Optional[dict] = None
 
     @property
     def done(self) -> bool:
@@ -498,8 +528,25 @@ class Engine:
         self._free_slots = list(range(B))
         self._pending: "queue.Queue[tuple[_Request, SamplingParams]]" = (
             queue.Queue(maxsize=cfg.max_queue))
-        self._head: Optional[tuple[_Request, SamplingParams]] = None
-        self._admitting: Optional[_Request] = None  # req in prefill flight
+        # Scheduler-owned admission backlog: _pull_pending drains the
+        # thread-safe intake queue here (bounded by max_queue, so the
+        # intake still sheds 429s under pressure) and the token-budget
+        # scheduler orders it by deadline slack each round.
+        self._backlog: list[tuple[_Request, SamplingParams]] = []
+        self._arrival_seq = itertools.count()
+        # Token-budget continuous scheduler (engine/scheduler.py): env
+        # overrides beat the config fields beat the PROFILE-derived
+        # default, mirroring the BENCH_* knob convention.
+        env_budget = os.environ.get("SCHED_ROUND_BUDGET_TOKENS", "")
+        env_chunk = os.environ.get("SCHED_PREFILL_CHUNK_TOKENS", "")
+        self._sched = TokenBudgetScheduler(
+            StepCostModel.load(), page_size=page,
+            steps_per_round=cfg.steps_per_round,
+            round_budget_tokens=(int(env_budget) if env_budget
+                                 else cfg.sched_round_budget_tokens),
+            chunk_tokens=(int(env_chunk) if env_chunk
+                          else cfg.sched_prefill_chunk_tokens),
+            max_one_shot_tokens=self._buckets[-1])
         # Harvest pipeline: the scheduler enqueues each dispatched
         # program's output (first-token scalars, decode-round token
         # blocks) onto ``_harvest_q`` in dispatch order; the harvest
@@ -524,6 +571,8 @@ class Engine:
 
         self._stats_lock = threading.Lock()
         self._stats = dict(_STATS_TEMPLATE)  # keys doc-checked, see above
+        self._stats["sched_round_budget_tokens"] = \
+            self._sched.round_budget_tokens
         # Decode-attention page windows: power-of-two ladder up to the max.
         ladder = []
         w = 1
@@ -907,6 +956,11 @@ class Engine:
             # but not yet harvested. >0 during steady decode means the
             # device never goes idle waiting for the host.
             out["dispatch_queue_depth"] = self._inflight_rounds
+        # Scheduler mix: what share of the budgeted work was prefill.
+        sched_total = out["sched_prefill_tokens"] + out["sched_decode_tokens"]
+        out["sched_prefill_share"] = (
+            round(out["sched_prefill_tokens"] / sched_total, 4)
+            if sched_total else 0.0)
         cache = self._prefix_cache
         if cache is not None:
             # Cache counters are written only on the serve-loop thread;
@@ -1275,89 +1329,6 @@ class Engine:
             self._chunk_fns[key] = fn
         return fn
 
-    def _admit_chunked(self, req: _Request, sp: SamplingParams, slot: int,
-                       row: np.ndarray, banned, bad_seq, bad_len,
-                       key, start_tok: int = 0,
-                       seen0: Optional[np.ndarray] = None) -> jax.Array:
-        """Stream a prompt's uncached tail through the paged pool in
-        chunk-size pieces; returns the first sampled token (device).
-        Each chunk is its own dispatch.
-
-        Two callers: longer-than-any-bucket prompts (``start_tok`` 0,
-        n_chunks round trips only long prompts ever see) and
-        prefix-cache hits (``start_tok`` = the page-aligned first
-        uncached token; the matched prefix is already mapped in ``row``
-        and each chunk's attention reads it straight from the pool — the
-        common warm-turn case is ONE dispatch for a short suffix).
-        ``seen0``: host-built (V,) seen mask over the cached prefix
-        tokens, folded into the first chunk's dispatch (a separate
-        seeding dispatch would put a whole device round trip back on the
-        TTFT path)."""
-        n = len(req.prompt_ids)
-        suffix = n - start_tok
-        # Cold long prompts stream at the largest bucket; a cache hit's
-        # suffix picks the smallest covering bucket so a short follow-up
-        # turn doesn't pay a max-bucket prefill for 50 new tokens.
-        C = (self._buckets[-1] if suffix > self._buckets[-1]
-             else self._bucket_for(suffix))
-        n_chunks = _ceil_div(suffix, C)
-        page = self.cfg.page_size
-        # The gather window must cover the PADDED chunk span, not just the
-        # request extent: a final chunk whose padding runs past the window
-        # would make dynamic_update_slice/dynamic_slice CLAMP their starts
-        # and silently relocate its KV over the prompt's own pages
-        # (review catch). Pages past the extent map to the trash page 0.
-        span_pages = start_tok // page + n_chunks * (C // page)
-        window = max(self._window_for(_ceil_div(req.extent, page)),
-                     span_pages)
-        row_ext = np.zeros((window,), np.int32)
-        row_ext[:min(len(row), window)] = row[:min(len(row), window)]
-        row_win = jnp.asarray(row_ext[None, :])
-        padded = list(req.prompt_ids[start_tok:]) \
-            + [0] * (n_chunks * C - suffix)
-        seed_arr = None if seen0 is None else jnp.asarray(seen0)
-        first_tok = None
-        tl = req.stream.timeline
-        for i in range(n_chunks):
-            t_chunk = time.monotonic()
-            toks = jnp.asarray(np.asarray(
-                padded[i * C:(i + 1) * C], np.int32)[None, :])
-            start = jnp.int32(start_tok + i * C)
-            valid = jnp.int32(min(n, start_tok + (i + 1) * C))
-            seeding = i == 0 and seed_arr is not None
-            self._guard_live()
-            if i < n_chunks - 1:
-                if seeding:
-                    new_state = self._chunk_extend_fn(window, "seed")(
-                        self._state, self.params, toks, start, valid,
-                        jnp.int32(slot), row_win, seed_arr)
-                else:
-                    mode = ("replace" if i == 0 and start_tok == 0
-                            else "accum")
-                    new_state = self._chunk_extend_fn(window, mode)(
-                        self._state, self.params, toks, start, valid,
-                        jnp.int32(slot), row_win)
-            else:
-                args = (self._state, self.params, toks, start, valid,
-                        jnp.int32(slot), jnp.asarray(row), row_win,
-                        jnp.float32(sp.temperature), jnp.int32(sp.top_k),
-                        jnp.float32(sp.top_p),
-                        jnp.float32(sp.repetition_penalty), banned, bad_seq,
-                        bad_len, key, jnp.int32(req.eff_max - 1),
-                        jnp.bool_(not sp.ignore_eos))
-                if seeding:
-                    args = args + (seed_arr,)
-                new_state, first_tok = self._chunk_final_fn(
-                    window, req.greedy, seeding)(*args)
-            self._guard_live()
-            self._state = new_state
-            if tl is not None:
-                # Host-side dispatch time of this chunk (the device work
-                # is async); one event per chunk, i == the chunk index.
-                tl.stage("engine_prefill_chunk",
-                         time.monotonic() - t_chunk)
-        return first_tok
-
     # ------------------------------------------------------------- lifecycle
 
     def start(self) -> None:
@@ -1472,13 +1443,9 @@ class Engine:
         item, and retirement (which removes the slot) only happens after
         the harvest worker finished their stream."""
         live: list[_Request] = []
-        if self._admitting is not None:  # mid-prefill, not yet in a slot
-            live.append(self._admitting)
-            self._admitting = None
         live += self._slots.values()
-        if self._head is not None:
-            live.append(self._head[0])
-            self._head = None
+        live += [req for req, _ in self._backlog]
+        self._backlog = []
         while not self._pending.empty():
             try:
                 live.append(self._pending.get_nowait()[0])
@@ -1756,16 +1723,31 @@ class Engine:
                        banned_np=banned_np, bad_seq_np=bad_seq_np,
                        bad_len_np=bad_len_np,
                        rag=(q_llm, len(ids), q_enc),
-                       deadline_t=self._resolve_deadline(stream, deadline_t))
-        try:
-            self._pending.put_nowait((req, params))
-        except queue.Full:
-            self._reject_full(stream)
+                       deadline_t=self._resolve_deadline(stream, deadline_t),
+                       seq=next(self._arrival_seq))
+        self._enqueue(req, params, stream)
         if self._fatal is not None:
             stream._fail(self._fatal)
         self._bump("requests")
         self._wake.set()
         return stream
+
+    def _enqueue(self, req: "_Request", params: SamplingParams,
+                 stream: TokenStream) -> None:
+        """Admission gate: ``max_queue`` bounds TOTAL queued work —
+        intake queue plus the scheduler's backlog — so the PR-5 meaning
+        of the knob (queued capacity before 429) survives the backlog
+        refactor; without this check the backlog would silently double
+        it. The combined read is approximate under concurrent
+        submitters (``qsize``/``len`` race by design, like every
+        queue-depth check), but the intake queue's own ``maxsize`` still
+        hard-bounds any overshoot."""
+        if len(self._backlog) + self._pending.qsize() >= self.cfg.max_queue:
+            self._reject_full(stream)
+        try:
+            self._pending.put_nowait((req, params))
+        except queue.Full:
+            self._reject_full(stream)
 
     def _reject_full(self, stream: TokenStream) -> None:
         """Queue-full rejection: count the shed, retire the timeline
@@ -1830,11 +1812,9 @@ class Engine:
                        banned_ids=banned_ids, bad_seqs=bad_seqs,
                        banned_np=banned_np, bad_seq_np=bad_seq_np,
                        bad_len_np=bad_len_np,
-                       deadline_t=self._resolve_deadline(stream, deadline_t))
-        try:
-            self._pending.put_nowait((req, params))
-        except queue.Full:
-            self._reject_full(stream)
+                       deadline_t=self._resolve_deadline(stream, deadline_t),
+                       seq=next(self._arrival_seq))
+        self._enqueue(req, params, stream)
         if self._fatal is not None:
             # The loop may have died between the check above and the put;
             # fail the stream here so callers never block forever.
@@ -1917,7 +1897,12 @@ class Engine:
                 req.cache_pages.add(req.pages[i])
 
     def _run(self) -> None:
-        """Scheduler thread: retire completions, admit, dispatch. NO device
+        """Scheduler thread: retire completions, then execute ROUND PLANS
+        from the token-budget scheduler — each iteration dispatches at
+        most one decode round plus the prefill chunks that fit under the
+        per-round budget (engine/scheduler.py), so a long prompt streams
+        through in page-quantized chunks between decode rounds instead
+        of monopolizing the loop until its prefill completes. NO device
         readback ever runs here — the harvest worker owns those — so the
         device queue stays >=1 round deep whenever there is work instead
         of draining behind a blocking np.asarray (the r5 ``loop_hround``
@@ -1932,25 +1917,16 @@ class Engine:
                 did_drain = self._drain_completed()
                 did_work = did_drain
                 t1 = time.monotonic()
-                did_admit = self._admit()
-                did_work |= did_admit
-                self._guard_live()
-                t2 = time.monotonic()
-                did_dispatch = False
-                while (self._slots
-                       and self._queued_rounds() < self.cfg.dispatch_depth
-                       and self._dispatch_round()):
-                    did_dispatch = did_work = True
-                self._guard_live()
-                t3 = time.monotonic()
-                # Only phases that did work: idle iterations would race a
-                # first-wins stage collector with meaningless ~0 values.
+                # Only phases that did work get recorded: idle iterations
+                # would race a first-wins stage collector with
+                # meaningless ~0 values.
                 if did_drain:
                     record_stage("loop_drain", t1 - t0)
-                if did_admit:
-                    record_stage("loop_admit", t2 - t1)
-                if did_dispatch:
-                    record_stage("loop_dispatch", t3 - t2)
+                self._pull_pending()
+                did_work |= self._cull_backlog()
+                plan = self._plan_round()
+                did_work |= self._execute_plan(plan)
+                self._guard_live()
                 if not did_work:
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()
@@ -2090,206 +2066,434 @@ class Engine:
             # _pending, including this item's members).
             self._wake.set()
 
-    def _next_pending(self) -> Optional[tuple[_Request, SamplingParams]]:
-        if self._head is None:
+    def _pull_pending(self) -> bool:
+        """Drain the thread-safe intake queue into the scheduler's
+        backlog. Pulls stop at ``max_queue`` backlog entries so the
+        intake queue still fills — and still sheds 429s — under
+        sustained overload; the backlog itself is scheduler-private and
+        re-ordered by deadline slack every round."""
+        moved = False
+        while len(self._backlog) < self.cfg.max_queue:
             try:
-                self._head = self._pending.get_nowait()
+                self._backlog.append(self._pending.get_nowait())
             except queue.Empty:
-                return None
-        return self._head
-
-    def _admit(self) -> bool:
-        """Dispatch prefill+insert for as many pending requests as slots
-        and KV pages allow. First-token harvest is deferred so it overlaps
-        with the decode rounds dispatched right after."""
-        admitted = False
-        while self._free_slots:
-            nxt = self._next_pending()
-            if nxt is None:
                 break
-            req, sp = nxt
+            moved = True
+        return moved
+
+    def _cull_backlog(self) -> bool:
+        """Shed cancelled and queue-expired backlog entries BEFORE any
+        slot/page is touched — the PR-5 ``deadline_queue`` path, now run
+        over the whole backlog every round instead of only at FIFO head
+        pickup (a deep expired request no longer waits for the queue to
+        drain past it before it is dropped)."""
+        kept: list[tuple[_Request, SamplingParams]] = []
+        did = False
+        now = time.monotonic()
+        for req, sp in self._backlog:
             if req.stream.cancelled:
-                self._head = None
                 req.stream._finish("cancelled")
+                did = True
                 continue
-            if (req.deadline_t is not None
-                    and time.monotonic() > req.deadline_t):
-                # Deadline expired while queued: the caller has already
-                # given up — prefilling it would burn a slot and device
-                # time on an answer nobody is waiting for. Dropped
-                # BEFORE any slot/page allocation; the stream finishes
-                # (empty) with the reason on its flight timeline.
-                self._head = None
+            if req.deadline_t is not None and now > req.deadline_t:
                 self._bump("deadline_queue_drops")
                 tl = req.stream.timeline
                 if tl is not None:
                     tl.stage("engine_admit_pickup",
-                             time.monotonic() - req.stream.submit_time)
+                             now - req.stream.submit_time)
                 req.stream._finish("deadline_queue")
+                did = True
                 continue
-            n_alloc = _ceil_div(req.extent, self.cfg.page_size)
-            # Shared-prefix match: map the longest cached block chain of
-            # this prompt read-only (refs taken NOW so pool-pressure
-            # eviction below can't reclaim it out from under us).
-            hashes, k_use, hit_pages = self._prefix_lookup(req)
-            start_tok = k_use * self.cfg.page_size
-            need_new = n_alloc - k_use
-            if need_new > len(self._free_pages):
-                # Pool pressure: reclaim retired requests' warm prefix
-                # pages (refcount 0, LRU leaf-first) before declaring
-                # backpressure — the cache borrows pool pages, it never
-                # shrinks serving capacity.
-                if self._prefix_cache is not None:
-                    self._free_pages.extend(self._prefix_cache.evict(
-                        need_new - len(self._free_pages)))
-                if need_new > len(self._free_pages):
-                    if k_use:
-                        self._prefix_cache.release(hashes[:k_use])
-                    break  # pool backpressure: wait for pages to free up
-            self._head = None
-            self._admitting = req  # tracked through the prefill dispatch
-            slot = self._free_slots.pop()
-            req.slot = slot
-            req.pages = hit_pages + [self._free_pages.pop()
-                                     for _ in range(need_new)]
-            req.cache_refs = list(hashes[:k_use])
-            req.cache_pages = set(hit_pages)
-            req.proj_pos = len(req.prompt_ids)
-            row = np.zeros((self._pmax,), np.int32)
-            row[:n_alloc] = req.pages
-            if self._prefix_cache is not None and req.rag is None:
-                st = self._prefix_cache.stats
-                st.lookups += 1
-                st.lookup_tokens += len(req.prompt_ids)
-                if start_tok:
-                    st.hits += 1
-                    st.hit_tokens += start_tok
+            kept.append((req, sp))
+        self._backlog = kept
+        return did
 
-            qwait = time.monotonic() - req.stream.submit_time
-            record_stage("engine_admit_pickup", qwait)
-            tl = req.stream.timeline
-            if tl is not None:
-                # Scheduler-side timeline events: queue wait, the slot
-                # and pages this request occupies, and how much of the
-                # prompt the prefix cache already held.
-                tl.stage("engine_admit_pickup", qwait)
-                tl.annotate(slot=slot, pages_held=len(req.pages),
-                            prefix_hit_tokens=start_tok)
-            faults.inject("engine.dispatch")  # chaos: slow/failed prefill
-            t_dispatch = time.monotonic()
-            # Masks/tables were built at submit() on the caller's thread
-            # (overlapped with the queue wait) — the serve loop only
-            # uploads them, keeping admission dispatch lean.
-            banned = jnp.asarray(req.banned_np)
-            bad_seq = jnp.asarray(req.bad_seq_np)
-            bad_len = jnp.asarray(req.bad_len_np)
-            # uploaded; don't pin ~vocab-size bytes per request for the
-            # rest of its lifetime (queue depth x 128k-vocab rows adds up)
-            req.banned_np = req.bad_seq_np = req.bad_len_np = None
-            key = jax.random.fold_in(self._base_key,
-                                     next(self._step_counter) ^ sp.random_seed)
-            # ONE dispatch for (retrieve+assemble+)prefill+sample+insert,
-            # with liveness re-checked before committing: reset() may have
-            # run while the program compiled, and a disowned thread must
-            # neither donate the rebuilt state nor overwrite it afterwards.
+    def _plan_round(self):
+        """Build this round's token-budget plan: the right-sized decode
+        dispatch (power-of-two step ladder, unchanged from the pre-
+        scheduler loop — a decode-only workload plans exactly the rounds
+        it always got) plus the prefill jobs the scheduler may grant
+        chunks to. In-flight prefills (slots mid-chunking) are offered
+        first; backlog admissions are offered only when a slot is free
+        and are slack-ordered inside plan_round."""
+        armed = [r for r in self._slots.values() if r.prefill_done]
+        need_steps = max((r.extent - r.proj_pos for r in armed), default=0)
+        steps = 0
+        if need_steps > 0 and self._queued_rounds() < self.cfg.dispatch_depth:
+            steps = self.cfg.steps_per_round
+            while steps // 2 >= need_steps:
+                steps //= 2
+        inflight = [
+            PrefillJob(key=r, remaining=len(r.prompt_ids) - r.pf_pos,
+                       deadline_t=r.deadline_t, seq=r.seq, started=True)
+            for r in self._slots.values() if not r.prefill_done]
+        backlog_jobs = []
+        if self._free_slots:
+            for req, _sp in self._backlog:
+                # Pre-admission estimate: the full prompt (a prefix-cache
+                # hit is only discovered at admission and can only SHRINK
+                # the real chunk plan). Fused-RAG prompts are assembled
+                # on-device at the spec's bucket size.
+                remaining = (self._fused_rag.spec.bucket
+                             if req.rag is not None
+                             else len(req.prompt_ids))
+                backlog_jobs.append(PrefillJob(
+                    key=req, remaining=remaining,
+                    deadline_t=req.deadline_t, seq=req.seq))
+        return self._sched.plan_round(
+            decode_steps=steps, active_decodes=len(armed),
+            inflight=inflight, backlog=backlog_jobs,
+            now=time.monotonic(), max_new=len(self._free_slots))
+
+    def _execute_plan(self, plan) -> bool:
+        """Dispatch one round plan: the decode round first (the latency-
+        critical work for every armed stream), then the granted prefill
+        chunks. Stops admitting on pool backpressure; counts the round
+        as interleaved when both kinds of work actually dispatched."""
+        did = False
+        decoded = False
+        t0 = time.monotonic()
+        if plan.decode_steps:
+            decoded = self._dispatch_round(plan.decode_steps)
+            if decoded:
+                did = True
+                self._bump("sched_decode_tokens", plan.decode_cost_tokens)
+                record_stage("loop_dispatch", time.monotonic() - t0)
+        t1 = time.monotonic()
+        prefilled = 0
+        for key, grant in plan.chunks:
+            req: _Request = key
+            if req.slot < 0:
+                if not self._free_slots:
+                    break
+                ok = self._begin_prefill(req)
+                if ok is None:     # dropped (cancel raced the grant)
+                    continue
+                if not ok:         # pool backpressure: stop admitting
+                    break
+            n = self._advance_prefill(req, grant)
             self._guard_live()
-            if req.rag is not None:
-                q_llm, q_len, q_enc = req.rag
-                fused = self._fused_rag
-                req.proj_pos = fused.spec.bucket  # device pos upper bound
-                new_state, first_tok = self._rag_jit(
-                    self._state, self.params, fused.enc_params,
-                    fused.corpus, jnp.asarray(q_enc), jnp.asarray(q_llm),
-                    jnp.int32(q_len), jnp.int32(slot), jnp.asarray(row),
-                    jnp.float32(sp.temperature), jnp.int32(sp.top_k),
-                    jnp.float32(sp.top_p),
-                    jnp.float32(sp.repetition_penalty), banned, bad_seq,
-                    bad_len, key,
-                    jnp.int32(req.eff_max - 1), jnp.bool_(not sp.ignore_eos),
-                    req.greedy)
-            elif start_tok > 0:
-                # Prefix-cache hit: the matched pages are already mapped
-                # in ``row``; prefill starts at the first uncached token
-                # and reads the shared prefix straight from the pool.
-                # The seen (repetition-penalty) mask over the skipped
-                # prefix is rebuilt host-side from the prompt itself and
-                # seeded into the first chunk's dispatch.
-                V = self.model_cfg.vocab_size
-                seen0 = np.zeros((V,), bool)
-                ids = np.asarray(req.prompt_ids[:start_tok], np.int64)
-                seen0[ids[(ids >= 0) & (ids < V)]] = True
-                first_tok = self._admit_chunked(req, sp, slot, row,
-                                                banned, bad_seq, bad_len,
-                                                key, start_tok=start_tok,
-                                                seen0=seen0)
-                new_state = self._state  # committed chunk-by-chunk
-            elif len(req.prompt_ids) > self._buckets[-1]:
-                # Long-prompt admission: the prompt streams through the
-                # paged pool in bucket-size chunks (each chunk attends
-                # the pooled prefix) — prompts are no longer capped by
-                # the largest compiled prefill bucket.
-                first_tok = self._admit_chunked(req, sp, slot, row,
-                                                banned, bad_seq, bad_len,
-                                                key)
-                new_state = self._state  # committed chunk-by-chunk
-            else:
-                bucket = self._bucket_for(len(req.prompt_ids))
-                ids = req.prompt_ids + [0] * (bucket - len(req.prompt_ids))
-                tokens = jnp.asarray(np.asarray(ids, np.int32)[None, :])
-                length = jnp.int32(len(req.prompt_ids))
-                new_state, first_tok = self._prefill_insert(
-                    self._state, self.params, tokens, length, jnp.int32(slot),
-                    jnp.asarray(row), jnp.float32(sp.temperature),
-                    jnp.int32(sp.top_k), jnp.float32(sp.top_p),
-                    jnp.float32(sp.repetition_penalty), banned, bad_seq,
-                    bad_len, key,
-                    jnp.int32(req.eff_max - 1), jnp.bool_(not sp.ignore_eos),
-                    req.greedy)
+            if n:
+                did = True
+                prefilled += n
+        if prefilled:
+            self._bump("sched_prefill_tokens", prefilled)
+            record_stage("loop_admit", time.monotonic() - t1)
+            if decoded:
+                self._bump("sched_interleaved_rounds")
+        return did
+
+    def _begin_prefill(self, req: _Request):
+        """Admission half 1: allocate the slot and pages, take prefix-
+        cache refs, and build the dispatch context the chunk programs
+        share. Returns True on success, False on pool backpressure (the
+        request stays in the backlog; the caller stops admitting this
+        round — pool pressure is global), None when the request was
+        dropped instead of admitted."""
+        if req.stream.cancelled:
+            self._backlog = [e for e in self._backlog if e[0] is not req]
+            req.stream._finish("cancelled")
+            return None
+        sp = req.params
+        n_alloc = _ceil_div(req.extent, self.cfg.page_size)
+        # Shared-prefix match: map the longest cached block chain of
+        # this prompt read-only (refs taken NOW so pool-pressure
+        # eviction below can't reclaim it out from under us).
+        hashes, k_use, hit_pages = self._prefix_lookup(req)
+        start_tok = k_use * self.cfg.page_size
+        need_new = n_alloc - k_use
+        if need_new > len(self._free_pages):
+            # Pool pressure: reclaim retired requests' warm prefix
+            # pages (refcount 0, LRU leaf-first) before declaring
+            # backpressure — the cache borrows pool pages, it never
+            # shrinks serving capacity.
+            if self._prefix_cache is not None:
+                self._free_pages.extend(self._prefix_cache.evict(
+                    need_new - len(self._free_pages)))
+            if need_new > len(self._free_pages):
+                if k_use:
+                    self._prefix_cache.release(hashes[:k_use])
+                return False  # pool backpressure: wait for pages
+        self._backlog = [e for e in self._backlog if e[0] is not req]
+        slot = self._free_slots.pop()
+        req.slot = slot
+        req.pages = hit_pages + [self._free_pages.pop()
+                                 for _ in range(need_new)]
+        req.cache_refs = list(hashes[:k_use])
+        req.cache_pages = set(hit_pages)
+        req.proj_pos = len(req.prompt_ids)
+        req.pf_pos = start_tok
+        row = np.zeros((self._pmax,), np.int32)
+        row[:n_alloc] = req.pages
+        if self._prefix_cache is not None and req.rag is None:
+            st = self._prefix_cache.stats
+            st.lookups += 1
+            st.lookup_tokens += len(req.prompt_ids)
+            if start_tok:
+                st.hits += 1
+                st.hit_tokens += start_tok
+
+        now = time.monotonic()
+        qwait = now - req.stream.submit_time
+        record_stage("engine_admit_pickup", qwait)
+        if req.deadline_t is not None:
+            # Slack at admission: the headroom left after the modeled
+            # prefill of the UNCACHED suffix. Clamped at 0 — the
+            # histogram answers "how much margin do admitted requests
+            # carry"; negative-slack admissions all land in the first
+            # bucket (they are also the ones deadline_stops later
+            # counts if the model was right).
+            slack = (req.deadline_t - now) - self._sched.cost.prefill_s(
+                len(req.prompt_ids) - start_tok)
+            record_stage("sched_slack", max(slack, 0.0))
+        tl = req.stream.timeline
+        if tl is not None:
+            # Scheduler-side timeline events: queue wait, the slot
+            # and pages this request occupies, and how much of the
+            # prompt the prefix cache already held.
+            tl.stage("engine_admit_pickup", qwait)
+            tl.annotate(slot=slot, pages_held=len(req.pages),
+                        prefix_hit_tokens=start_tok)
+        # Masks/tables were built at submit() on the caller's thread
+        # (overlapped with the queue wait) — the serve loop only
+        # uploads them, keeping admission dispatch lean.
+        banned = jnp.asarray(req.banned_np)
+        bad_seq = jnp.asarray(req.bad_seq_np)
+        bad_len = jnp.asarray(req.bad_len_np)
+        # uploaded; don't pin ~vocab-size bytes per request for the
+        # rest of its lifetime (queue depth x 128k-vocab rows adds up)
+        req.banned_np = req.bad_seq_np = req.bad_len_np = None
+        key = jax.random.fold_in(self._base_key,
+                                 next(self._step_counter) ^ sp.random_seed)
+        # Chunk-window geometry (only the chunked path reads it): the
+        # gather window must cover the PADDED chunk span, not just the
+        # request extent — a chunk whose padding runs past the window
+        # would make dynamic_update_slice/dynamic_slice CLAMP their
+        # starts and silently relocate KV over the prompt's own pages.
+        # Chunk pads come from the prefill-bucket ladder, so one extra
+        # max-bucket of pages covers any final-chunk overhang; pages
+        # past the extent map to the trash page 0.
+        page = self.cfg.page_size
+        span_pages = (start_tok // page
+                      + _ceil_div(len(req.prompt_ids) - start_tok, page)
+                      + self._buckets[-1] // page)
+        window = max(self._window_for(_ceil_div(req.extent, page)),
+                     span_pages)
+        row_ext = np.zeros((window,), np.int32)
+        row_ext[:min(len(row), window)] = row[:min(len(row), window)]
+        seen0 = None
+        if start_tok > 0:
+            # Prefix-cache hit: the seen (repetition-penalty) mask over
+            # the skipped prefix is rebuilt host-side from the prompt
+            # itself and seeded into the first chunk's dispatch.
+            V = self.model_cfg.vocab_size
+            seen0 = np.zeros((V,), bool)
+            ids = np.asarray(req.prompt_ids[:start_tok], np.int64)
+            seen0[ids[(ids >= 0) & (ids < V)]] = True
+        req.pf = {
+            "row": row, "row_win": jnp.asarray(row_ext[None, :]),
+            "window": window, "start_tok": start_tok,
+            "hashes": hashes, "k_use": k_use,
+            "seed": None if seen0 is None else jnp.asarray(seen0),
+            "banned": banned, "bad_seq": bad_seq, "bad_len": bad_len,
+            "key": key, "dispatch_s": 0.0,
+        }
+        self._slots[slot] = req
+        self._bump("prefills")
+        return True
+
+    def _abort_prefill(self, req: _Request, finish: str) -> None:
+        """Retire a mid-prefill request (cancel / passed deadline). The
+        slot was never armed on the device (``active`` stays False until
+        the final chunk), so no device release is needed — just the
+        slot/page/cache-ref bookkeeping."""
+        req.pf = None
+        self._retire(req, finish)
+
+    def _chunk_pad(self, n: int) -> int:
+        """Compiled shape for an ``n``-token chunk: the smallest prefill
+        bucket that covers it — chunk programs reuse the bucket ladder's
+        shapes, so interleaving adds no new compile geometries."""
+        return self._bucket_for(n)
+
+    def _advance_prefill(self, req: _Request, grant: int) -> int:
+        """Admission half 2, run once per round plan: dispatch ONE
+        prefill chunk of up to ``grant`` tokens (bucket-shape padded).
+        The final chunk arms the slot and hands the first token to the
+        harvest worker. Returns the prompt tokens computed (0 = nothing
+        dispatched). Short cold prompts whose whole extent fits the
+        grant keep the ONE-dispatch fused prefill+insert path — the
+        TTFT-critical case is still a single program."""
+        sp = req.params
+        if req.rag is not None:
+            return self._dispatch_rag(req)
+        pf = req.pf
+        if req.pf_pos > pf["start_tok"]:
+            # Between-chunk aborts only: an admission that began keeps
+            # the PR-5 contract (its first dispatch runs and the harvest
+            # path notices cancellation/deadline at the first token) —
+            # but a MULTI-chunk prefill whose caller is gone stops
+            # sinking further rounds into an unwanted answer.
+            if req.stream.cancelled:
+                self._abort_prefill(req, "cancelled")
+                return 0
+            if req.deadline_t is not None \
+                    and time.monotonic() > req.deadline_t:
+                # Counted as a mid-flight deadline stop (the request DID
+                # consume compute, unlike a deadline_queue drop).
+                self._bump("deadline_stops")
+                self._abort_prefill(req, "deadline")
+                return 0
+        total = len(req.prompt_ids)
+        page = self.cfg.page_size
+        n = min(grant, total - req.pf_pos, self._buckets[-1])
+        final = req.pf_pos + n >= total
+        if not final:
+            n = (n // page) * page
+            if n <= 0:
+                return 0
+        faults.inject("engine.dispatch")  # chaos: slow/failed prefill
+        t_chunk = time.monotonic()
+        key = pf["key"]
+        if final and req.pf_pos == 0 and total <= self._buckets[-1]:
+            # Whole cold prompt in one grant: the classic fused
+            # prefill+sample+insert dispatch (one program boundary on
+            # the TTFT path — see _build_jitted).
+            bucket = self._bucket_for(total)
+            ids = req.prompt_ids + [0] * (bucket - total)
+            tokens = jnp.asarray(np.asarray(ids, np.int32)[None, :])
+            self._guard_live()
+            new_state, first_tok = self._prefill_insert(
+                self._state, self.params, tokens, jnp.int32(total),
+                jnp.int32(req.slot), jnp.asarray(pf["row"]),
+                jnp.float32(sp.temperature), jnp.int32(sp.top_k),
+                jnp.float32(sp.top_p), jnp.float32(sp.repetition_penalty),
+                pf["banned"], pf["bad_seq"], pf["bad_len"], key,
+                jnp.int32(req.eff_max - 1), jnp.bool_(not sp.ignore_eos),
+                req.greedy)
             self._guard_live()
             self._state = new_state
-            self._register_prefix(req, hashes, k_use)
-            admit_dt = time.monotonic() - t_dispatch
-            record_stage("engine_admit_dispatch", admit_dt)
-            if tl is not None:
-                tl.stage("engine_admit_dispatch", admit_dt)
-            try:
-                # Start the device->host transfer of the first token now —
-                # the harvest worker's np.asarray then finds the value
-                # host-side (or at least in flight) instead of paying the
-                # full readback RTT after the fact.
-                first_tok.copy_to_host_async()
-            except Exception:  # noqa: BLE001 — optional fast path
-                pass
-            self._bump("prefills")
-            self._slots[slot] = req
-            self._admitting = None
-            # Hand the first-token readback to the harvest worker: the
-            # wait for it overlaps the decode rounds dispatched right
-            # after this admission instead of gating them (FIFO order in
-            # the queue keeps it ahead of those rounds' tokens).
-            self._harvest_q.put(("first", req, first_tok))
-            admitted = True
-        return admitted
+        else:
+            C = self._chunk_pad(n)
+            chunk = req.prompt_ids[req.pf_pos:req.pf_pos + n] \
+                + [0] * (C - n)
+            toks = jnp.asarray(np.asarray(chunk, np.int32)[None, :])
+            start = jnp.int32(req.pf_pos)
+            valid = jnp.int32(req.pf_pos + n)
+            seeding = (req.pf_pos == pf["start_tok"]
+                       and pf["seed"] is not None)
+            self._guard_live()
+            if not final:
+                if seeding:
+                    new_state = self._chunk_extend_fn(pf["window"], "seed")(
+                        self._state, self.params, toks, start, valid,
+                        jnp.int32(req.slot), pf["row_win"], pf["seed"])
+                else:
+                    mode = ("replace"
+                            if req.pf_pos == 0 and pf["start_tok"] == 0
+                            else "accum")
+                    new_state = self._chunk_extend_fn(pf["window"], mode)(
+                        self._state, self.params, toks, start, valid,
+                        jnp.int32(req.slot), pf["row_win"])
+                first_tok = None
+            else:
+                args = (self._state, self.params, toks, start, valid,
+                        jnp.int32(req.slot), jnp.asarray(pf["row"]),
+                        pf["row_win"], jnp.float32(sp.temperature),
+                        jnp.int32(sp.top_k), jnp.float32(sp.top_p),
+                        jnp.float32(sp.repetition_penalty), pf["banned"],
+                        pf["bad_seq"], pf["bad_len"], key,
+                        jnp.int32(req.eff_max - 1),
+                        jnp.bool_(not sp.ignore_eos))
+                if seeding:
+                    args = args + (pf["seed"],)
+                new_state, first_tok = self._chunk_final_fn(
+                    pf["window"], req.greedy, seeding)(*args)
+            self._guard_live()
+            self._state = new_state
+        dt = time.monotonic() - t_chunk
+        pf["dispatch_s"] += dt
+        tl = req.stream.timeline
+        if tl is not None:
+            # Host-side dispatch time of this chunk (the device work
+            # is async); one event per chunk.
+            tl.stage("engine_prefill_chunk", dt)
+        req.pf_pos += n
+        if final:
+            self._arm_slot(req, first_tok)
+        return n
 
-    def _dispatch_round(self) -> bool:
-        """Dispatch one decode round, or decline (False) when every slot's
-        projected position already covers its extent — an extra round would
-        be pure masked work delaying the next admit's prefill by a whole
-        round of device time."""
+    def _arm_slot(self, req: _Request, first_tok) -> None:
+        """Prefill complete: publish cache blocks, mark the slot armed
+        for decode rounds, and hand the first-token readback to the
+        harvest worker (its wait overlaps the decode rounds dispatched
+        right after — FIFO order in the queue keeps it ahead of them)."""
+        pf = req.pf
+        self._register_prefix(req, pf["hashes"], pf["k_use"])
+        record_stage("engine_admit_dispatch", pf["dispatch_s"])
+        tl = req.stream.timeline
+        if tl is not None:
+            # Cumulative host dispatch time across every chunk of this
+            # admission — the same meaning the one-dispatch path always
+            # had, now summed over the interleaved pieces.
+            tl.stage("engine_admit_dispatch", pf["dispatch_s"])
+        try:
+            # Start the device->host transfer of the first token now —
+            # the harvest worker's np.asarray then finds the value
+            # host-side (or at least in flight) instead of paying the
+            # full readback RTT after the fact.
+            first_tok.copy_to_host_async()
+        except Exception:  # noqa: BLE001 — optional fast path
+            pass
+        req.pf = None
+        req.prefill_done = True
+        self._harvest_q.put(("first", req, first_tok))
+
+    def _dispatch_rag(self, req: _Request) -> int:
+        """Fused-RAG admission: retrieval + assembly + prefill happen in
+        ONE device program, so the dispatch is atomic — the scheduler
+        charges the whole assembled bucket against the round budget (a
+        grant can't split an on-device assembly)."""
+        sp = req.params
+        pf = req.pf
+        faults.inject("engine.dispatch")  # chaos: slow/failed prefill
+        t0 = time.monotonic()
+        q_llm, q_len, q_enc = req.rag
+        fused = self._fused_rag
+        req.proj_pos = fused.spec.bucket  # device pos upper bound
+        self._guard_live()
+        new_state, first_tok = self._rag_jit(
+            self._state, self.params, fused.enc_params,
+            fused.corpus, jnp.asarray(q_enc), jnp.asarray(q_llm),
+            jnp.int32(q_len), jnp.int32(req.slot),
+            jnp.asarray(pf["row"]),
+            jnp.float32(sp.temperature), jnp.int32(sp.top_k),
+            jnp.float32(sp.top_p),
+            jnp.float32(sp.repetition_penalty), pf["banned"],
+            pf["bad_seq"], pf["bad_len"], pf["key"],
+            jnp.int32(req.eff_max - 1), jnp.bool_(not sp.ignore_eos),
+            req.greedy)
+        self._guard_live()
+        self._state = new_state
+        pf["dispatch_s"] += time.monotonic() - t0
+        self._arm_slot(req, first_tok)
+        return fused.spec.bucket
+
+    def _dispatch_round(self, steps: int) -> bool:
+        """Dispatch one decode round of ``steps`` fused steps (the plan
+        right-sized them against the power-of-two ladder), or decline
+        (False) when no ARMED slot still needs tokens — slots mid-
+        chunked-prefill are excluded: they are inactive on the device
+        until their final chunk arms them, so a round over them would be
+        pure masked work."""
+        members = {s: r for s, r in self._slots.items() if r.prefill_done}
         need_steps = max((r.extent - r.proj_pos for r in
-                          self._slots.values()), default=0)
-        if need_steps <= 0:
+                          members.values()), default=0)
+        if need_steps <= 0 or steps <= 0:
             return False
         faults.inject("engine.dispatch")  # chaos: slow/failed decode round
-        # Right-size the final round: a power-of-two step ladder keeps the
-        # compile count low while the tail of a generation doesn't pay for
-        # a full round of masked steps.
-        K = self.cfg.steps_per_round
-        steps = K
-        while steps // 2 >= need_steps:
-            steps //= 2
         need = max(min(r.proj_pos + steps, r.extent) + 1
-                   for r in self._slots.values())
+                   for r in members.values())
         # Kernel path: pass the full table — the kernel's per-slot dynamic
         # loop bound already scales HBM reads with live context, so there
         # is exactly ONE compiled round per (steps, greedy) instead of a
@@ -2299,8 +2503,7 @@ class Engine:
             window = self._pmax
         else:
             window = self._window_for(_ceil_div(need, self.cfg.page_size))
-        greedy = all(r.greedy for r in self._slots.values())
-        members = dict(self._slots)
+        greedy = all(r.greedy for r in members.values())
         key = jax.random.fold_in(self._base_key, next(self._step_counter))
         new_state, toks = self._round_fn(window, steps, greedy)(
             self.params, self._state, key)

@@ -1,0 +1,360 @@
+"""Token-budget continuous scheduler: chunked-prefill / decode interleaving.
+
+The prefill wall (BENCH_r05): e2e chat TTFT is 180 ms of which
+``engine_first_readback`` is 173 ms — prefill IS the TTFT budget, and the
+engine's former run-prefill-to-completion admission let one long prompt
+monopolize the serve loop while every occupied decode slot starved. The
+cure is the Sarathi/Orca recipe adapted to this engine's multi-step
+rounds: plan each engine round as a MIX of decode steps for armed slots
+plus prefill *chunks* for admitted requests, sized so the whole round
+stays under a per-round token budget derived from a measured step-cost
+model — decode keeps flowing at its usual cadence while long prefills
+make page-quantized progress in the gaps.
+
+Division of labor:
+
+- **This module is pure host-side policy** — no jax, no device state, no
+  engine internals. It converts (decode work this round, prefill jobs
+  waiting) into a :class:`RoundPlan` under the budget, and orders
+  admission by DEADLINE SLACK (requests whose deadline minus estimated
+  prefill time is smallest go first; ties by arrival). That keeps every
+  decision unit-testable without an engine.
+- **The engine** (engine.py ``_plan_round``/``_execute_plan``) owns
+  resources: it offers only what slots/pages allow, executes chunk
+  dispatches, and keeps the PR-5 deadline semantics (queue-expired
+  requests shed via ``deadline_queue`` before any page is touched).
+
+Cost model: :class:`StepCostModel` loads the committed
+``PROFILE_rNN.json`` roofline artifact (``tools/profile_decode.py
+--json`` regenerates it per deployment, now including a measured
+``prefill_ms_per_token``) and falls back to conservative defaults when
+the artifact or a field is missing. The derived default budget is the
+number of prefill tokens whose modeled cost equals ONE decode round —
+i.e. piggybacked prefill can at most ~double a round's latency, the
+stall-free-batching knee. ``SCHED_ROUND_BUDGET_TOKENS`` /
+``SCHED_PREFILL_CHUNK_TOKENS`` (env or EngineConfig) override it per
+deployment (docs/configuration.md).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import math
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+@dataclass(frozen=True)
+class StepCostModel:
+    """Per-deployment serving costs, in milliseconds.
+
+    ``decode_step_ms`` is one fused decode step across ALL slots (the
+    profile's ``full_ms_per_step``); ``prefill_ms_per_token`` is one
+    prompt token through prefill. The ratio between them is what the
+    budget derivation actually consumes: how many prefill tokens cost as
+    much as a decode round.
+    """
+
+    decode_step_ms: float = 2.0
+    prefill_ms_per_token: float = 0.125
+    slots: int = 8
+    source: str = "default"
+
+    @classmethod
+    def from_profile(cls, profile: dict, source: str = "profile"
+                     ) -> "StepCostModel":
+        decode = float(profile.get("full_ms_per_step") or 2.0)
+        slots = int(profile.get("slots") or 8)
+        prefill = profile.get("prefill_ms_per_token")
+        if not prefill or prefill <= 0:
+            # Older artifacts (≤ r06) predate the prefill measurement:
+            # estimate a token's prefill cost from the decode step —
+            # per-slot decode cost discounted by prefill's batching
+            # efficiency (a whole bucket amortizes weight streaming the
+            # way a decode step amortizes it over slots; 4x is the
+            # conservative end of the measured 3-8x range).
+            prefill = decode / max(1, slots) / 4.0
+        return cls(decode_step_ms=decode,
+                   prefill_ms_per_token=float(prefill),
+                   slots=slots, source=source)
+
+    @classmethod
+    def load(cls, path: Optional[str] = None) -> "StepCostModel":
+        """Resolve the deployment's cost model: explicit ``path``, else
+        ``SCHED_PROFILE_JSON``, else the newest committed
+        ``PROFILE_rNN.json`` at the repo root, else defaults. A missing
+        or malformed artifact degrades silently to defaults — the
+        scheduler must never keep an engine from building."""
+        candidates = []
+        if path:
+            candidates.append(path)
+        env = os.environ.get("SCHED_PROFILE_JSON", "")
+        if env:
+            candidates.append(env)
+        def _round_no(p: str) -> int:
+            m = re.search(r"_r(\d+)\.json$", p)
+            return int(m.group(1)) if m else -1
+        # Numeric sort on the round number — lexicographic would pick
+        # r99 over r100 (and r9 over r10) the day rounds grow a digit.
+        candidates.extend(sorted(
+            glob.glob(os.path.join(_REPO_ROOT, "PROFILE_r*.json")),
+            key=_round_no, reverse=True))
+        for cand in candidates:
+            # Catch the full malformed-artifact surface, not just parse
+            # errors: valid JSON that isn't an object of numbers (`[]`,
+            # `{"prefill_ms_per_token": "fast"}`) raises Attribute/Type
+            # errors out of from_profile — the fallback contract above
+            # covers those the same as a missing file.
+            try:
+                with open(cand) as f:
+                    return cls.from_profile(json.load(f),
+                                            source=os.path.basename(cand))
+            except (OSError, ValueError, TypeError, AttributeError,
+                    KeyError):
+                continue
+        return cls()
+
+    def prefill_s(self, tokens: int) -> float:
+        """Modeled wall seconds to prefill ``tokens`` prompt tokens."""
+        return max(0, tokens) * self.prefill_ms_per_token / 1e3
+
+    def decode_round_ms(self, steps: int) -> float:
+        return steps * self.decode_step_ms
+
+
+def derive_round_budget(model: StepCostModel, steps_per_round: int,
+                        page_size: int) -> int:
+    """Default per-round prefill-token budget: the tokens whose modeled
+    prefill cost equals one full decode round. At that size a round that
+    piggybacks a chunk takes at most ~2x a pure decode round — decoding
+    streams keep flowing while prefill makes real progress. Quantized to
+    whole pages (chunks scatter KV page-wise); floored at one page so a
+    pathological cost model can never stall admission."""
+    tokens = model.decode_round_ms(steps_per_round) / model.prefill_ms_per_token
+    pages = max(1, int(tokens) // page_size)
+    return pages * page_size
+
+
+@dataclass
+class PrefillJob:
+    """One prefill the scheduler may advance this round.
+
+    ``key`` is an opaque handle (the engine's ``_Request``) echoed back
+    in the plan. ``remaining`` counts tokens still to COMPUTE: the
+    prompt minus everything already prefilled minus any prefix-cache hit
+    — a warm request's chunk plan shrinks by exactly its cached prefix
+    (the PR-1 interaction; see docs/scheduler.md)."""
+
+    key: object
+    remaining: int
+    deadline_t: Optional[float] = None
+    seq: int = 0
+    started: bool = False    # already holds a slot (in-flight chunks)
+
+
+@dataclass
+class RoundPlan:
+    """One engine round: the decode dispatch (steps and how many armed
+    slots ride it) plus the prefill chunks that fit under the budget."""
+
+    decode_steps: int
+    active_decodes: int
+    chunks: list = field(default_factory=list)  # [(key, grant_tokens)]
+    budget_tokens: int = 0
+
+    @property
+    def decode_cost_tokens(self) -> int:
+        return self.decode_steps * max(1, self.active_decodes) \
+            if self.decode_steps else 0
+
+    @property
+    def prefill_tokens(self) -> int:
+        return sum(n for _, n in self.chunks)
+
+    @property
+    def interleaved(self) -> bool:
+        return bool(self.decode_steps and self.chunks)
+
+
+class TokenBudgetScheduler:
+    """Plans rounds under a token budget; orders admission by slack.
+
+    Token units: one prefill token costs 1; one decode step costs one
+    token PER ACTIVE SLOT (each armed slot emits a token per step — the
+    same normalization Sarathi/vLLM budgets use, and it makes the
+    budget directly comparable to ``tokens_generated``).
+    """
+
+    def __init__(self, cost: StepCostModel, *, page_size: int,
+                 steps_per_round: int,
+                 round_budget_tokens: Optional[int] = None,
+                 chunk_tokens: Optional[int] = None,
+                 max_one_shot_tokens: Optional[int] = None):
+        self.cost = cost
+        self.page_size = page_size
+        self.steps_per_round = steps_per_round
+        if round_budget_tokens is not None:
+            budget = max(page_size, int(round_budget_tokens))
+        else:
+            budget = derive_round_budget(cost, steps_per_round, page_size)
+        self.round_budget_tokens = budget
+        # Per-chunk cap: a single request's grant within one round.
+        # Defaults to the whole budget (the budget is already the round
+        # latency bound); the knob exists to force finer interleaving.
+        self.chunk_tokens = max(page_size, int(chunk_tokens)) \
+            if chunk_tokens else budget
+        # Above this, a request is never one-shot even on an idle engine
+        # (the engine passes its largest prefill bucket).
+        self.max_one_shot_tokens = max_one_shot_tokens
+        if max_one_shot_tokens is not None:
+            # The bucket is also the largest single DISPATCH the engine
+            # can execute: a grant beyond it would deduct budget for
+            # tokens _advance_prefill clamps away — planned work that
+            # evaporates instead of going to other waiting prefills.
+            self.chunk_tokens = min(self.chunk_tokens,
+                                    max(page_size, max_one_shot_tokens))
+        # Fair-rotation cursor: when the leftover is too small for every
+        # job to get a page (the 1-page default budget is the common
+        # case), WHO gets this round's page rotates across rounds so a
+        # waiting job's admission is bounded by ~len(jobs) rounds.
+        self._rr = 0
+
+    # ------------------------------------------------------------ slack
+
+    def slack_s(self, job: PrefillJob, now: float) -> float:
+        """Deadline slack: seconds to spare if this job's prefill
+        started NOW — (deadline - now) minus its modeled prefill time.
+        No deadline → +inf (deadline'd traffic goes first; among
+        unconstrained requests arrival order holds)."""
+        if job.deadline_t is None:
+            return math.inf
+        return (job.deadline_t - now) - self.cost.prefill_s(job.remaining)
+
+    def order(self, jobs: Sequence[PrefillJob], now: float
+              ) -> list[PrefillJob]:
+        """Admission order: smallest slack first, arrival order as the
+        tiebreak (and the total order for no-deadline traffic). The
+        engine sheds queue-EXPIRED requests before offering jobs here
+        (PR-5 ``deadline_queue``); negative-slack-but-unexpired jobs
+        sort first — their only chance of meeting the deadline is
+        starting immediately."""
+        return sorted(jobs, key=lambda j: (self.slack_s(j, now), j.seq))
+
+    # ------------------------------------------------------------- plan
+
+    def plan_round(self, *, decode_steps: int, active_decodes: int,
+                   inflight: Sequence[PrefillJob] = (),
+                   backlog: Sequence[PrefillJob] = (),
+                   now: float = 0.0,
+                   max_new: Optional[int] = None) -> RoundPlan:
+        """Pack one round: decode first (decode is NEVER displaced —
+        stall-free batching means ongoing generations keep their
+        cadence), then prefill chunks into the leftover budget.
+
+        ``inflight`` jobs (mid-prefill, already holding a slot) advance
+        before new admissions — arming a half-done slot frees budget
+        sooner than starting another prompt. ``backlog`` jobs are
+        admission candidates ordered by slack here; ``max_new`` caps how
+        many of them (slack-order first) may be granted this round — the
+        engine passes its free-slot count, so budget is never split
+        across jobs that cannot start and then wasted when the executor
+        runs out of slots.
+
+        Grants are whole pages except a job's FINAL grant (the engine's
+        final-chunk program takes any tail length). Two liveness
+        guarantees: if prefill work exists, at least one page is granted
+        even when decode consumed the whole budget (a saturated decode
+        fleet must not starve admission forever), and on an IDLE engine
+        (nothing decoding, nothing else waiting) a lone job up to 2x the
+        round budget (and never past ``max_one_shot_tokens``, the
+        largest compiled bucket) is granted whole — chunking a typical
+        prompt would tax its TTFT with extra dispatches while protecting
+        nobody, but an UNBOUNDED one-shot is un-preemptible once
+        dispatched and would re-open the prefill wall for a request
+        arriving moments later.
+        """
+        plan = RoundPlan(decode_steps=decode_steps,
+                         active_decodes=active_decodes,
+                         budget_tokens=self.round_budget_tokens)
+        admitted = self.order(backlog, now)
+        if max_new is not None:
+            admitted = admitted[:max(0, max_new)]
+        jobs = list(inflight) + admitted
+        if not jobs:
+            return plan
+        page = self.page_size
+        leftover = self.round_budget_tokens - plan.decode_cost_tokens
+        # Liveness floor: decode saturation may never starve prefill.
+        leftover = max(leftover, page)
+        # Idle engine, one waiter: whole-prompt grant (see docstring) —
+        # but only up to 2x the round budget (and never past the largest
+        # compiled bucket). A dispatched grant is un-preemptible, so an
+        # unbounded one-shot would re-open the prefill wall for whoever
+        # arrives a microsecond later: a lone 3072-token prompt would
+        # monopolize the device for its whole prefill. 2x the budget
+        # keeps the lone-prompt fast path for typical prompts while
+        # bounding any later arrival's wait to ~2 extra round-times.
+        one_shot_cap = 2 * self.round_budget_tokens
+        if self.max_one_shot_tokens is not None:
+            one_shot_cap = min(one_shot_cap, self.max_one_shot_tokens)
+        if (decode_steps == 0 and active_decodes == 0 and len(jobs) == 1
+                and not jobs[0].started
+                and jobs[0].remaining <= one_shot_cap):
+            plan.chunks.append((jobs[0].key, jobs[0].remaining))
+            return plan
+        # Two-phase packing. Phase 1 hands every job a FAIR SHARE of the
+        # leftover (page-quantized, one page minimum): a short prompt
+        # behind a long in-flight prefill admits THIS round instead of
+        # waiting out the whole long prefill — strict priority order
+        # would starve it, which is the head-of-line blocking this
+        # scheduler exists to kill. Phase 2 re-grants whatever the
+        # fair pass left unused (jobs smaller than their share) to the
+        # highest-priority jobs so no budget is wasted.
+        share = max(page, (leftover // len(jobs)) // page * page)
+        # Scarcity rotation: when the leftover can't give every job a
+        # page (e.g. the 1-page default budget), a fixed packing order
+        # would hand the SAME job the page every round — strict
+        # head-of-line blocking in fair-share clothing. Rotating who
+        # packs first across rounds bounds any job's wait for its next
+        # page to ~len(jobs) rounds.
+        order_idx = list(range(len(jobs)))
+        if leftover < page * len(jobs):
+            start = self._rr % len(jobs)
+            order_idx = order_idx[start:] + order_idx[:start]
+        self._rr += 1
+        granted: dict[int, int] = {}      # job index -> raw tokens
+        for phase_cap in (share, None):
+            for i in order_idx:
+                job = jobs[i]
+                if leftover <= 0:
+                    break
+                cap = leftover if phase_cap is None else phase_cap
+                grant = min(job.remaining - granted.get(i, 0),
+                            self.chunk_tokens - granted.get(i, 0),
+                            cap, leftover)
+                if grant < job.remaining - granted.get(i, 0):
+                    grant = (grant // page) * page
+                if grant <= 0:
+                    continue
+                granted[i] = granted.get(i, 0) + grant
+                leftover -= grant
+        for i, job in enumerate(jobs):
+            total = granted.get(i, 0)
+            if total <= 0:
+                continue
+            if total < job.remaining:
+                # Non-final grant: quantize DOWN to whole pages so every
+                # later chunk starts page-aligned (chunk KV scatters
+                # page-wise; a ragged boundary would split a page across
+                # two dispatches).
+                total = (total // page) * page
+                if total <= 0:
+                    continue
+            plan.chunks.append((job.key, total))
+        return plan

@@ -1,0 +1,47 @@
+"""Version portability for the sharding APIs the parallel layer uses.
+
+Same pattern as the engine's ``_layout_api`` shim (engine/engine.py):
+jax moved ``shard_map`` out of ``jax.experimental`` into the top-level
+namespace around 0.5/0.6 and grew ``jax.lax.pcast`` and
+``jax.tree.leaves_with_path`` in the same window. On 0.4.x those
+spellings raise AttributeError at trace time — which is exactly how the
+seed-failing ``test_moe``/``test_pipeline``/``test_weight_cache`` runs
+died. Callers import the portable spellings from here instead of
+version-gating at every site.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map_04x
+
+    def shard_map(f, *, mesh, in_specs, out_specs, **kwargs):
+        """0.4.x spelling. ``check_rep`` is disabled because the callers
+        were written against the new API's explicit replication casts
+        (``pcast``), which 0.4.x cannot express — the old rep checker
+        would reject values the new API marks varying. Semantics are
+        unchanged; only the static replication audit is skipped."""
+        kwargs.setdefault("check_rep", False)
+        return _shard_map_04x(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, **kwargs)
+
+
+if hasattr(jax.lax, "pcast"):
+    pcast = jax.lax.pcast
+else:
+    def pcast(x, axes, to):  # noqa: ARG001 — mirror the new signature
+        """Replication-cast is purely a static annotation for the new
+        API's varying-manual-axes checker; on 0.4.x (where the checker
+        is disabled above) the value itself is already correct, so the
+        cast is the identity."""
+        return x
+
+
+if hasattr(jax.tree, "leaves_with_path"):
+    tree_leaves_with_path = jax.tree.leaves_with_path
+else:
+    from jax.tree_util import tree_leaves_with_path  # noqa: F401  0.4.x home

@@ -116,7 +116,8 @@ def ep_expert_ffn(mesh: Mesh, expert_in: jax.Array, w_gate: jax.Array,
         out = _expert_ffn(ei, g, u, d)
         return jax.lax.psum(out, "tp")
 
-    return jax.shard_map(
+    from .compat import shard_map
+    return shard_map(
         local, mesh=mesh,
         in_specs=(P("ep", None, None), P("ep", None, "tp"),
                   P("ep", None, "tp"), P("ep", "tp", None)),

@@ -1,0 +1,105 @@
+"""Tier-1 CPU smoke of the open-loop Poisson-arrival bench scenario:
+a short burst end-to-end through a real tiny engine, and the schema
+contract for the new ``openloop`` section (SLO attainment / goodput —
+the headline metrics the closed-loop scenarios cannot produce)."""
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import bench
+from generativeaiexamples_tpu.engine import Engine, EngineConfig
+from generativeaiexamples_tpu.models import llama
+from generativeaiexamples_tpu.models.configs import LlamaConfig
+from generativeaiexamples_tpu.models.tokenizer import ByteTokenizer
+from tools.check_bench_schema import (BenchSchemaError, load_schema,
+                                      validate_result)
+
+CFG = LlamaConfig(vocab_size=259 + 5, hidden_size=64, intermediate_size=128,
+                  num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+                  max_position_embeddings=256)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    params = llama.init_params(CFG, jax.random.key(11), dtype=jnp.float32)
+    eng = Engine(params, CFG, ByteTokenizer(), EngineConfig(
+        max_slots=2, max_input_length=64, max_output_length=16,
+        prefill_buckets=(16, 32, 64), dtype="float32", page_size=16,
+        kv_pool_tokens=None, max_queue=64, steps_per_round=4))
+    with eng:
+        yield eng
+
+
+def _run(engine, **over):
+    kw = dict(rates=[25.0], duration_s=0.6, slo_ttft_ms=30000.0,
+              deadline_ms=60000.0, prompt_median=16, prompt_sigma=0.4,
+              out_len=4, seed=0)
+    kw.update(over)
+    return bench.run_openloop_bench(engine, **kw)
+
+
+def test_openloop_burst_end_to_end(engine):
+    section = _run(engine)
+    assert section["arrival_rps_sweep"] == [25.0]
+    (rate,) = section["rates"]
+    assert rate["offered"] >= 1
+    assert rate["completed"] + rate["shed"] + rate["deadline_drops"] \
+        <= rate["offered"]
+    assert 0.0 <= rate["slo_attainment"] <= 1.0
+    assert rate["goodput_tokens_per_sec"] >= 0.0
+    assert rate["tokens_total"] >= rate["completed"] * 4
+    # generous SLOs on an unloaded tiny engine: everything should land
+    assert rate["slo_attainment"] > 0.0
+    assert rate["ttft_p99_ms"] is not None and rate["ttft_p99_ms"] > 0
+
+
+def test_openloop_sweep_is_deterministic_per_seed(engine):
+    a = _run(engine, duration_s=0.4)
+    b = _run(engine, duration_s=0.4)
+    assert a["rates"][0]["offered"] == b["rates"][0]["offered"]
+
+
+def test_openloop_tight_slo_lowers_attainment(engine):
+    """An SLO below any achievable TTFT yields attainment 0 — the metric
+    really reads the per-request TTFTs, not just completion."""
+    section = _run(engine, duration_s=0.4, slo_ttft_ms=0.001)
+    assert section["rates"][0]["slo_attainment"] == 0.0
+    assert section["rates"][0]["goodput_tokens_per_sec"] == 0.0
+
+
+def _synthetic_with(openloop):
+    pipeline = bench.pipeline_snapshot({})
+    return bench.assemble_result(
+        kind="engine", model="llama-tiny", headline=10.0,
+        engine_p50=8.0, engine_p99=12.0, tput=100.0,
+        achieved_bw=1e9, bw_util=0.1, bw_steady=True,
+        chat=None, e2e_p50=None, e2e_dist=None, e2e_breakdown=None,
+        e2e_tps_p50=None, pipeline=pipeline, quant="none", kv_quant=None,
+        weights="random-init", prompt_len=16, out_len=4, slots=2,
+        steps_per_round=4, kv_pool_pages=8, device="cpu", rtt_ms=None,
+        n_devices=1, bench_seconds=1.0, openloop=openloop)
+
+
+def test_openloop_section_schema_valid(engine):
+    """The emitted section validates under tools/bench_schema.json via
+    the same assemble_result path the chip bench uses; closed-loop-only
+    results (openloop null) keep validating too."""
+    validate_result(_synthetic_with(_run(engine, duration_s=0.4)))
+    validate_result(_synthetic_with(None))
+
+
+def test_openloop_rate_field_rename_fails_fast(engine):
+    section = _run(engine, duration_s=0.4)
+    section["rates"][0]["goodput_toks"] = \
+        section["rates"][0].pop("goodput_tokens_per_sec")
+    with pytest.raises(BenchSchemaError, match="openloop.rates"):
+        validate_result(_synthetic_with(section))
+
+
+def test_openloop_schema_section_matches_emitted_keys(engine):
+    schema = load_schema()
+    section = _run(engine, duration_s=0.4)
+    assert set(section) == set(schema["openloop"])
+    assert set(section["rates"][0]) == set(schema["openloop_rate"])

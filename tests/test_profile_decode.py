@@ -18,6 +18,8 @@ REQUIRED_KEYS = {
     "full_ms_per_step", "no_unembed_ms_per_step", "window1_ms_per_step",
     "unembed_ms_per_step", "window_stream_ms_per_step",
     "matmul_floor_ms_per_step", "tokens_per_sec",
+    # step-cost model inputs for the token-budget scheduler
+    "prefill_bucket_tokens", "prefill_ms_per_token",
 }
 
 

@@ -1,0 +1,421 @@
+"""Token-budget continuous scheduler (engine/scheduler.py): budget
+packing, slack ordering, chunk accounting — plus engine-level proof that
+chunked prefill actually interleaves with decode (a long prompt no
+longer blocks a concurrent short request's first token) while a
+decode-only workload plans exactly the rounds it always got."""
+
+import time
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from generativeaiexamples_tpu.engine import Engine, EngineConfig, SamplingParams
+from generativeaiexamples_tpu.engine.scheduler import (
+    PrefillJob, RoundPlan, StepCostModel, TokenBudgetScheduler,
+    derive_round_budget)
+from generativeaiexamples_tpu.models import llama
+from generativeaiexamples_tpu.models.configs import LlamaConfig
+from generativeaiexamples_tpu.models.tokenizer import ByteTokenizer
+
+CFG = LlamaConfig(vocab_size=259 + 5, hidden_size=64, intermediate_size=128,
+                  num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+                  max_position_embeddings=256)
+
+PAGE = 16
+
+
+def make_sched(budget=64, chunk=None, cost=None, one_shot_cap=64):
+    return TokenBudgetScheduler(
+        cost or StepCostModel(decode_step_ms=2.0, prefill_ms_per_token=0.25),
+        page_size=PAGE, steps_per_round=4, round_budget_tokens=budget,
+        chunk_tokens=chunk, max_one_shot_tokens=one_shot_cap)
+
+
+# --------------------------------------------------------- cost model
+
+
+def test_cost_model_from_profile_and_default_prefill_estimate():
+    m = StepCostModel.from_profile({
+        "full_ms_per_step": 3.0, "slots": 4,
+        "prefill_ms_per_token": 0.5})
+    assert m.decode_step_ms == 3.0 and m.prefill_ms_per_token == 0.5
+    # artifacts predating the prefill measurement estimate it from the
+    # decode step (per-slot cost / 4x batching efficiency)
+    old = StepCostModel.from_profile({"full_ms_per_step": 4.0, "slots": 8})
+    assert old.prefill_ms_per_token == pytest.approx(4.0 / 8 / 4)
+    assert old.prefill_s(1000) == pytest.approx(0.125)
+
+
+def test_derive_round_budget_page_quantized_and_floored():
+    m = StepCostModel(decode_step_ms=2.0, prefill_ms_per_token=0.25)
+    # 4 steps * 2 ms / 0.25 ms per token = 32 tokens -> 2 pages of 16
+    assert derive_round_budget(m, 4, PAGE) == 32
+    # a pathological model still yields at least one page
+    tiny = StepCostModel(decode_step_ms=0.001, prefill_ms_per_token=10.0)
+    assert derive_round_budget(tiny, 4, PAGE) == PAGE
+
+
+def test_load_falls_back_to_defaults(tmp_path, monkeypatch):
+    monkeypatch.setenv("SCHED_PROFILE_JSON", str(tmp_path / "missing.json"))
+    # unreadable env path falls through to the committed artifact or the
+    # defaults — never raises
+    m = StepCostModel.load()
+    assert m.decode_step_ms > 0 and m.prefill_ms_per_token > 0
+
+
+# ------------------------------------------------------ budget packing
+
+
+def test_plan_decode_only_unchanged():
+    plan = make_sched().plan_round(decode_steps=4, active_decodes=2)
+    assert plan.decode_steps == 4 and not plan.chunks
+    assert plan.decode_cost_tokens == 8
+    assert not plan.interleaved
+
+
+def test_plan_respects_budget_and_page_quantizes():
+    sched = make_sched(budget=48)
+    long_job = PrefillJob(key="long", remaining=200, seq=0, started=True)
+    plan = sched.plan_round(decode_steps=0, active_decodes=0,
+                            inflight=[long_job])
+    # whole leftover, quantized down to whole pages, never over budget
+    assert plan.chunks == [("long", 48)]
+    assert plan.prefill_tokens <= plan.budget_tokens
+
+
+def test_plan_decode_cost_shrinks_prefill_share():
+    sched = make_sched(budget=64)
+    job = PrefillJob(key="j", remaining=500, seq=0, started=True)
+    # 4 steps x 2 active slots = 8 token-equivalents of decode cost;
+    # the prefill grant shrinks accordingly (56 -> 48 after paging)
+    plan = sched.plan_round(decode_steps=4, active_decodes=2,
+                            inflight=[job])
+    assert plan.decode_cost_tokens == 8
+    assert plan.chunks == [("j", 48)]
+    assert plan.interleaved
+
+
+def test_plan_liveness_floor_under_decode_saturation():
+    # decode eats the whole budget; a waiting prefill still gets a page
+    sched = make_sched(budget=32)
+    job = PrefillJob(key="j", remaining=100, seq=0, started=True)
+    plan = sched.plan_round(decode_steps=4, active_decodes=32,
+                            inflight=[job])
+    assert plan.chunks == [("j", PAGE)]
+
+
+def test_plan_idle_engine_one_shots_a_lone_short_prompt():
+    sched = make_sched(budget=PAGE, one_shot_cap=64)
+    job = PrefillJob(key="j", remaining=30, seq=0)
+    plan = sched.plan_round(decode_steps=0, active_decodes=0, backlog=[job])
+    # nothing to protect: the whole prompt goes in one grant even though
+    # it exceeds the budget — up to 2x the budget
+    assert plan.chunks == [("j", 30)]
+    # ...beyond 2x the budget a lone prompt CHUNKS even on an idle
+    # engine: a dispatched grant is un-preemptible, so an unbounded
+    # one-shot would re-open the prefill wall for the next arrival
+    big = PrefillJob(key="b", remaining=60, seq=0)
+    plan = sched.plan_round(decode_steps=0, active_decodes=0, backlog=[big])
+    assert plan.chunks[0][1] <= 2 * PAGE
+    # the bucket cap binds when it is the smaller of the two
+    tight = make_sched(budget=64, one_shot_cap=PAGE)
+    huge = PrefillJob(key="h", remaining=65, seq=0)
+    plan = tight.plan_round(decode_steps=0, active_decodes=0, backlog=[huge])
+    assert plan.chunks[0][1] <= PAGE
+
+
+def test_plan_fair_share_admits_short_behind_long():
+    # The acceptance shape: a long in-flight prefill plus a short
+    # waiting prompt. Fair-share packing must grant the short its WHOLE
+    # prompt this round (it fits the share), not starve it behind the
+    # long prefill.
+    sched = make_sched(budget=32)
+    long_job = PrefillJob(key="long", remaining=100, seq=0, started=True)
+    short_job = PrefillJob(key="short", remaining=8, seq=1)
+    plan = sched.plan_round(decode_steps=0, active_decodes=0,
+                            inflight=[long_job], backlog=[short_job])
+    grants = dict(plan.chunks)
+    assert grants["short"] == 8          # final grant, sub-page allowed
+    assert grants["long"] >= PAGE        # long still progresses
+    assert plan.prefill_tokens <= plan.budget_tokens
+
+
+def test_plan_greedy_second_pass_uses_leftover():
+    # one small job + one big job, lots of budget: the big job gets the
+    # share AND the leftover the small job didn't need
+    sched = make_sched(budget=64)
+    big = PrefillJob(key="big", remaining=300, seq=0, started=True)
+    small = PrefillJob(key="small", remaining=8, seq=1, started=True)
+    plan = sched.plan_round(decode_steps=0, active_decodes=0,
+                            inflight=[big, small])
+    grants = dict(plan.chunks)
+    assert grants["small"] == 8
+    assert grants["big"] == 48  # 64 - 8 = 56 -> page-quantized 48
+
+
+def test_plan_max_new_caps_admissions_to_free_slots():
+    """``max_new`` (the engine's free-slot count) bounds how many
+    backlog jobs get grants — budget is never split across jobs the
+    executor cannot admit, and the slack-ordered FRONT of the backlog
+    is what gets through, not arrival order."""
+    sched = make_sched(budget=64)
+    inflight = PrefillJob(key="busy", remaining=200, seq=0, started=True)
+    relaxed = PrefillJob(key="relaxed", remaining=32, seq=1,
+                         deadline_t=100.0)
+    urgent = PrefillJob(key="urgent", remaining=32, seq=2, deadline_t=1.0)
+    plan = sched.plan_round(decode_steps=0, active_decodes=0,
+                            inflight=[inflight],
+                            backlog=[relaxed, urgent], now=0.0, max_new=1)
+    grants = dict(plan.chunks)
+    assert "urgent" in grants          # smallest slack wins the slot
+    assert "relaxed" not in grants     # no grant for a job with no slot
+    # the budget the capped job would have eaten goes to live work
+    assert grants["busy"] >= PAGE
+    assert plan.prefill_tokens <= plan.budget_tokens
+
+
+def test_plan_chunk_cap_bounds_single_grant():
+    sched = make_sched(budget=64, chunk=PAGE)
+    job = PrefillJob(key="j", remaining=500, seq=0, started=True)
+    plan = sched.plan_round(decode_steps=0, active_decodes=0,
+                            inflight=[job])
+    assert plan.chunks == [("j", PAGE)]
+
+
+def test_plan_chunk_grants_capped_at_prefill_bucket():
+    """A grant can never exceed the largest compiled prefill bucket —
+    the engine clamps the dispatch there, so a bigger grant would burn
+    budget on tokens that never execute."""
+    sched = TokenBudgetScheduler(
+        StepCostModel(decode_step_ms=2.0, prefill_ms_per_token=0.25),
+        page_size=PAGE, steps_per_round=4, round_budget_tokens=256,
+        max_one_shot_tokens=64)
+    a = PrefillJob(key="a", remaining=500, seq=0, started=True)
+    b = PrefillJob(key="b", remaining=500, seq=1, started=True)
+    plan = sched.plan_round(decode_steps=0, active_decodes=0,
+                            inflight=[a, b])
+    grants = dict(plan.chunks)
+    assert max(grants.values()) <= 64
+    # the budget the cap freed went to the OTHER job, not to waste
+    assert grants["a"] + grants["b"] > 64
+
+
+def test_plan_scarcity_rotation_bounds_single_page_starvation():
+    """1-page leftover (the PROFILE-derived default budget on real
+    configs) + two jobs: a fixed packing order would hand the same job
+    the page every round. Rotation alternates, so the second job's wait
+    for its first page is bounded by ~len(jobs) rounds."""
+    sched = make_sched(budget=PAGE)
+    first_page_owner = []
+    for _ in range(4):
+        long_job = PrefillJob(key="long", remaining=400, seq=0,
+                              started=True)
+        short_job = PrefillJob(key="short", remaining=8, seq=1)
+        plan = sched.plan_round(decode_steps=0, active_decodes=1,
+                                inflight=[long_job], backlog=[short_job])
+        assert plan.prefill_tokens >= 8  # liveness floor every round
+        first_page_owner.append(plan.chunks[0][0])
+    assert "short" in first_page_owner    # the waiter got a round
+    assert "long" in first_page_owner     # the long prefill still moves
+
+
+# ------------------------------------------------------- slack ordering
+
+
+def test_slack_ordering_deadlines_first_then_arrival():
+    sched = make_sched()
+    now = 100.0
+    relaxed = PrefillJob(key="r", remaining=64, deadline_t=now + 10, seq=0)
+    urgent = PrefillJob(key="u", remaining=64, deadline_t=now + 0.1, seq=1)
+    nodeadline_a = PrefillJob(key="a", remaining=64, seq=2)
+    nodeadline_b = PrefillJob(key="b", remaining=64, seq=3)
+    order = [j.key for j in sched.order(
+        [nodeadline_b, relaxed, nodeadline_a, urgent], now)]
+    assert order == ["u", "r", "a", "b"]
+
+
+def test_slack_accounts_for_prefill_time():
+    # same deadline, different prompt length: the longer prompt has less
+    # slack (its prefill eats more of the budget) and goes first
+    sched = make_sched(cost=StepCostModel(decode_step_ms=2.0,
+                                          prefill_ms_per_token=1.0))
+    now = 0.0
+    short_p = PrefillJob(key="s", remaining=10, deadline_t=1.0, seq=0)
+    long_p = PrefillJob(key="l", remaining=900, deadline_t=1.0, seq=1)
+    assert sched.slack_s(long_p, now) < sched.slack_s(short_p, now)
+    assert [j.key for j in sched.order([short_p, long_p], now)] == ["l", "s"]
+
+
+def test_chunk_accounting_with_prefix_cache_hit():
+    # a warm request's job carries only the UNCACHED suffix, so its
+    # grants (and modeled slack) shrink by the cached prefix
+    sched = make_sched(budget=32)
+    cold = PrefillJob(key="cold", remaining=64, seq=0, started=True)
+    warm = PrefillJob(key="warm", remaining=16, seq=1, started=True)
+    plan = sched.plan_round(decode_steps=0, active_decodes=0,
+                            inflight=[cold, warm])
+    grants = dict(plan.chunks)
+    assert grants["warm"] == 16          # the suffix completes this round
+    assert grants["cold"] == 16
+    assert sched.cost.prefill_s(warm.remaining) < \
+        sched.cost.prefill_s(cold.remaining)
+
+
+# --------------------------------------------------------- engine-level
+
+
+def _engine(**over):
+    cfg = dict(max_slots=2, max_input_length=64, max_output_length=16,
+               prefill_buckets=(16, 32, 64), dtype="float32",
+               page_size=PAGE, kv_pool_tokens=None, max_queue=64,
+               steps_per_round=4)
+    cfg.update(over)
+    params = llama.init_params(CFG, jax.random.key(3), dtype=jnp.float32)
+    return Engine(params, CFG, ByteTokenizer(), EngineConfig(**cfg))
+
+
+def test_engine_interleaves_short_past_long_prefill():
+    """One long + one short prompt submitted together: the short
+    request's first token must land BEFORE the long prompt finishes its
+    chunked prefill — the prefill wall this PR exists to kill. (Before
+    the scheduler, admission ran the long prefill to completion first:
+    the long request's first token always beat the short's.)"""
+    eng = _engine(sched_round_budget_tokens=32)
+    try:
+        long_s = eng.submit([5] * 64, SamplingParams(max_tokens=4, top_k=1,
+                                                     ignore_eos=True))
+        short_s = eng.submit([9] * 8, SamplingParams(max_tokens=4, top_k=1,
+                                                     ignore_eos=True))
+        eng.start()   # both requests are in the same first round plan
+        short_s.text()
+        long_s.text()
+        assert short_s.first_token_time < long_s.first_token_time
+        assert len(short_s.token_ids) == 4 and len(long_s.token_ids) == 4
+        stats = eng.stats
+        # the long prompt streamed through in >= 2 budget-sized chunks
+        assert stats["sched_prefill_tokens"] >= 64 + 8
+        assert stats["sched_round_budget_tokens"] == 32
+        # decode rounds for the short request ran while the long prompt
+        # was still prefilling — the interleaving itself
+        assert stats["sched_interleaved_rounds"] >= 1
+    finally:
+        eng.stop()
+
+
+def test_engine_chunked_output_matches_one_shot():
+    """Forcing tiny chunks must not change WHAT the long prompt
+    generates — chunked paged prefill is exact (same math as the
+    one-shot bucket, modulo dispatch boundaries)."""
+    prompt = [3 + (i % 7) for i in range(64)]
+    sp = SamplingParams(max_tokens=6, top_k=1, ignore_eos=True)
+    # One engine serves both phases (prefix cache off so the second run
+    # really recomputes): a lone prompt on an IDLE engine one-shots even
+    # under a tiny budget — the idle fast-path — then a decoding
+    # neighbor keeps the engine busy so the resubmission takes the
+    # chunked path.
+    eng = _engine(sched_round_budget_tokens=PAGE, prefix_cache=False)
+    try:
+        eng.start()
+        one_shot = eng.submit(prompt, sp)
+        one_shot.text()
+        assert eng.stats["sched_interleaved_rounds"] == 0
+        noise = eng.submit([11] * 8, SamplingParams(
+            max_tokens=16, top_k=1, ignore_eos=True))
+        chunked = eng.submit(prompt, sp)
+        chunked.text()
+        noise.text()
+        assert eng.stats["sched_interleaved_rounds"] >= 1
+    finally:
+        eng.stop()
+    assert chunked.token_ids == one_shot.token_ids
+
+
+def test_engine_decode_only_rounds_unchanged():
+    """No prefill pending: the plan dispatches full steps_per_round
+    rounds with a right-sized tail — exactly the pre-scheduler cadence
+    (tokens per round unchanged; nothing counted as interleaved)."""
+    eng = _engine(max_slots=1, steps_per_round=8)
+    try:
+        eng.start()
+        s = eng.submit([7] * 8, SamplingParams(max_tokens=17, top_k=1,
+                                               ignore_eos=True))
+        s.text()
+        stats = eng.stats
+        assert len(s.token_ids) == 17
+        # 1 prefill token + 16 decode tokens in rounds of 8
+        assert stats["decode_steps"] == 16
+        assert stats["harvest_rounds"] == 2
+        assert stats["sched_interleaved_rounds"] == 0
+        assert stats["sched_decode_tokens"] == 16
+    finally:
+        eng.stop()
+
+
+def test_engine_budget_env_override(monkeypatch):
+    monkeypatch.setenv("SCHED_ROUND_BUDGET_TOKENS", "48")
+    eng = _engine()
+    try:
+        assert eng._sched.round_budget_tokens == 48
+        assert eng.stats["sched_round_budget_tokens"] == 48
+    finally:
+        eng.stop()
+
+
+def test_engine_warm_admission_prefills_suffix_only():
+    """PR-1 interaction: a prefix-cache hit shrinks the chunk plan — the
+    warm admission's granted prefill tokens cover only the uncached
+    suffix."""
+    eng = _engine(max_slots=1)
+    try:
+        eng.start()
+        prompt = [4 + (i % 9) for i in range(32)]
+        sp = SamplingParams(max_tokens=2, top_k=1, ignore_eos=True)
+        eng.submit(prompt, sp).text()
+        cold_tokens = eng.stats["sched_prefill_tokens"]
+        eng.submit(prompt, sp).text()
+        warm_tokens = eng.stats["sched_prefill_tokens"] - cold_tokens
+        hit = eng.stats["prefix_cache_hit_tokens"]
+        assert hit > 0
+        assert warm_tokens == len(prompt) - hit
+        assert warm_tokens < cold_tokens
+    finally:
+        eng.stop()
+
+
+def test_engine_stats_expose_sched_gauges():
+    eng = _engine()
+    try:
+        stats = eng.stats
+        for key in ("sched_round_budget_tokens", "sched_prefill_tokens",
+                    "sched_decode_tokens", "sched_interleaved_rounds",
+                    "sched_prefill_share"):
+            assert key in stats
+        assert stats["sched_round_budget_tokens"] >= PAGE
+        assert stats["sched_prefill_share"] == 0.0
+    finally:
+        eng.stop()
+
+
+def test_engine_deadline_sheds_from_reordered_backlog():
+    """PR-5 integration: queue-expired requests shed via deadline_queue
+    from anywhere in the backlog (not just FIFO head), and deadline'd
+    traffic is admitted ahead of earlier-arrived no-deadline traffic."""
+    eng = _engine(max_slots=1)
+    try:
+        # occupy the only slot so later submissions queue
+        busy = eng.submit([7] * 8, SamplingParams(max_tokens=16, top_k=1,
+                                                  ignore_eos=True))
+        eng.start()
+        filler = eng.submit([8] * 8, SamplingParams(max_tokens=2, top_k=1,
+                                                    ignore_eos=True))
+        expired = eng.submit([9] * 8, SamplingParams(max_tokens=2),
+                             deadline_t=time.monotonic())  # already past
+        assert expired.text() == ""
+        assert expired.finish_reason == "deadline_queue"
+        busy.text()
+        filler.text()
+        assert eng.stats["deadline_queue_drops"] == 1
+    finally:
+        eng.stop()

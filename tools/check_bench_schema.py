@@ -75,10 +75,25 @@ def validate_result(result: dict, schema: dict | None = None) -> None:
     schema = schema or load_schema()
     errors: list[str] = []
     _check_types("result", result, schema["top_level"], errors)
-    for section in ("engine_pipeline", "e2e_ttft_dist_ms", "chat"):
+    for section in ("engine_pipeline", "e2e_ttft_dist_ms", "chat",
+                    "openloop"):
         sub = result.get(section)
         if isinstance(sub, dict):
             _check_types(section, sub, schema[section], errors)
+    # Open-loop sweep: each per-rate entry carries the SLO-attainment /
+    # goodput headline fields — validated element-wise so a rename in
+    # one rate's dict can't hide behind the list type.
+    openloop = result.get("openloop")
+    if isinstance(openloop, dict):
+        rates = openloop.get("rates")
+        if isinstance(rates, list):
+            for i, entry in enumerate(rates):
+                if isinstance(entry, dict):
+                    _check_types(f"openloop.rates[{i}]", entry,
+                                 schema["openloop_rate"], errors)
+                else:
+                    errors.append(
+                        f"openloop.rates[{i}]: {entry!r} is not an object")
     breakdown = result.get("e2e_breakdown_ms")
     if isinstance(breakdown, dict):
         allowed = set(schema["breakdown_stages"])

@@ -116,6 +116,34 @@ def main(json_path: str = ""):
           f"window stream ~{full-w1:.2f} ms/step, "
           f"matmul floor {floor:.2f} ms/step @819GB/s")
 
+    # Prefill token cost: one bucket-shaped forward (the engine's
+    # admission program minus insert), timed per token. This is the
+    # OTHER half of the scheduler's step-cost model
+    # (engine/scheduler.py StepCostModel): the per-round chunk budget is
+    # decode_round_ms / prefill_ms_per_token, so regenerating this
+    # artifact per deployment re-derives the budget for that hardware.
+    S = min(int(os.environ.get("PROF_PREFILL_BUCKET", "512")),
+            cfg.max_position_embeddings)
+
+    def prefill_fn(p, tokens, positions):
+        c = llama.init_kv_cache(cfg, 1, S, dt)
+        logits, _ = llama.apply(p, cfg, tokens, positions, c)
+        return logits[:, -1]
+
+    pf = jax.jit(prefill_fn)
+    tok1 = jnp.ones((1, S), jnp.int32)
+    pos1 = jnp.arange(S, dtype=jnp.int32)[None, :]
+    for _ in range(2):
+        jax.block_until_ready(pf(params, tok1, pos1))
+    n = 4
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = pf(params, tok1, pos1)
+    jax.block_until_ready(out)
+    prefill_ms_tok = (time.perf_counter() - t0) / n / S * 1e3
+    print(f"prefill@{S}: {prefill_ms_tok:.4f} ms/token "
+          f"({S/( (time.perf_counter()-t0)/n ):.0f} tok/s-equivalent)")
+
     if json_path:
         # Roofline attribution as a committed round artifact: the same
         # shape every round, so the driver diffs attribution (did the
@@ -138,6 +166,11 @@ def main(json_path: str = ""):
             "window_stream_ms_per_step": round(full - w1, 3),
             "matmul_floor_ms_per_step": round(floor, 3),
             "tokens_per_sec": round(B / full * 1e3, 1),
+            # Step-cost model inputs for the token-budget scheduler
+            # (engine/scheduler.py): prefill cost per prompt token at
+            # the measured bucket.
+            "prefill_bucket_tokens": S,
+            "prefill_ms_per_token": round(prefill_ms_tok, 4),
         }
         with open(json_path, "w") as f:
             json.dump(artifact, f, indent=2)
