@@ -454,6 +454,272 @@ def run_openloop_bench(engine, *, rates, duration_s=10.0, slo_ttft_ms=500.0,
     return out
 
 
+def serve_apps(apps: list):
+    """Serve N aiohttp apps on one background event loop, each on an
+    ephemeral port. Returns (urls, stop_fn). Shared by the fleet
+    scenario (N chain replicas + the router in one process) and its
+    tier-1 smoke test."""
+    from aiohttp import web
+
+    loop = asyncio.new_event_loop()
+    box: dict = {"ports": []}
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+
+        async def boot():
+            for app in apps:
+                runner = web.AppRunner(app)
+                await runner.setup()
+                site = web.TCPSite(runner, "127.0.0.1", 0)
+                await site.start()
+                box["ports"].append(runner.addresses[0][1])
+        loop.run_until_complete(boot())
+        started.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    if not started.wait(60):
+        raise RuntimeError("fleet servers failed to boot")
+
+    def stop():
+        loop.call_soon_threadsafe(loop.stop)
+
+    return [f"http://127.0.0.1:{p}" for p in box["ports"]], stop
+
+
+def build_fleet_engines(params, model_cfg, tokenizer, n: int):
+    """N small replica engines over SHARED params (read-only on device —
+    weights are never duplicated) with explicit, modest KV pools
+    (``BENCH_FLEET_KV_POOL_TOKENS``, default 4096 tokens each): the main
+    bench engine's auto-sized pool still holds its HBM, so auto-sizing
+    here would starve; prewarm's shrink-on-OOM absorbs the rest."""
+    from generativeaiexamples_tpu.engine import Engine, EngineConfig
+
+    pool = int(os.environ.get("BENCH_FLEET_KV_POOL_TOKENS", "4096"))
+    slots = int(os.environ.get("BENCH_FLEET_SLOTS", "4"))
+    ecfg = EngineConfig(
+        max_slots=slots, max_input_length=2048, max_output_length=128,
+        prefill_buckets=(512, 1024), dtype="bfloat16",
+        kv_pool_tokens=pool,
+        kv_quant=os.environ.get("BENCH_KV_QUANT", ""),
+        steps_per_round=int(os.environ.get("BENCH_STEPS_PER_ROUND", "16")),
+        dispatch_depth=int(os.environ.get("BENCH_DISPATCH_DEPTH", "2")))
+    engines = [Engine(params, model_cfg, tokenizer, ecfg)
+               for _ in range(n)]
+    for e in engines:
+        e.prewarm()
+    return engines
+
+
+def run_fleet_bench(engines, *, sessions=6, turns=4, session_rps=2.0,
+                    system_chars=1200, user_chars=120, num_tokens=16,
+                    slo_ttft_ms=2000.0, seed=0,
+                    policies=("round_robin", "affinity"),
+                    heartbeat_s=0.5):
+    """Multi-replica scenario: open-loop Poisson session load through the
+    FLEET ROUTER over N in-process chain-server replicas (docs/router.md).
+
+    The workload is the cross-replica version of the chat scenario:
+    ``sessions`` multi-turn conversations arrive as a Poisson process at
+    ``session_rps``; each session carries a session-unique system prompt
+    and a growing history (the shared-prefix traffic shape), runs its
+    turns sequentially (a real chat user), and every turn goes through
+    the router's ``/generate``. Run once per placement policy —
+    ``round_robin`` (the baseline: affinity and load ignored) and
+    ``affinity`` (prefix-affinity + load + health) — with
+    policy-unique content so no run rides another's warm KV pages.
+
+    Headline per policy: **prefix_hit_rate** (cross-replica: summed
+    engine prefix-cache hit/lookup deltas across ALL replicas — the
+    number affinity routing exists to move) and **slo_attainment**
+    (turns whose first byte beat ``slo_ttft_ms``). Affinity keeps a
+    session's turns on the replica holding its prefix pages; round-robin
+    re-prefills the whole history on a cold sibling every hop — that
+    delta is the fleet-level warm-TTFT story.
+    """
+    import statistics
+
+    import numpy as _np
+    import requests
+
+    from generativeaiexamples_tpu.chains.examples.developer_rag import (
+        QAChatbot)
+    from generativeaiexamples_tpu.chains.llm import EngineLLM
+    from generativeaiexamples_tpu.chains.server import create_app
+    from generativeaiexamples_tpu.embed.encoder import HashEmbedder
+    from generativeaiexamples_tpu.obs import metrics as obs_metrics
+    from generativeaiexamples_tpu.router.server import create_router_app
+    from generativeaiexamples_tpu.utils.app_config import AppConfig
+    from generativeaiexamples_tpu.utils.configuration import from_dict
+
+    cfg = from_dict(AppConfig, {
+        "llm": {"model_engine": "tpu-jax"},
+        "embeddings": {"model_engine": "hash", "dimensions": 32},
+    })
+    for eng in engines:
+        eng.start()
+    apps = [create_app(QAChatbot(llm=EngineLLM(eng),
+                                 embedder=HashEmbedder(dim=32),
+                                 config=cfg, fused_rag=False), config=cfg)
+            for eng in engines]
+
+    def words(tag: str, n_chars: int) -> str:
+        # Deterministic filler, unique per tag: the prompt content is
+        # what the affinity sketch and the engine prefix cache both key
+        # on, so cross-session/cross-policy uniqueness is load-bearing.
+        # blake2b, not hash() — PYTHONHASHSEED would break determinism.
+        import hashlib
+        h = int.from_bytes(hashlib.blake2b(
+            tag.encode(), digest_size=4).digest(), "little")
+        rng = _np.random.RandomState(h)
+        toks = []
+        total = 0
+        while total < n_chars:
+            w = "".join(chr(97 + c) for c in rng.randint(0, 26, size=5))
+            toks.append(w)
+            total += 6
+        return " ".join(toks)[:n_chars]
+
+    def one_policy(policy: str, replica_urls: list[str]) -> dict:
+        router_app = create_router_app(
+            [(f"r{i}", u) for i, u in enumerate(replica_urls)],
+            policy=policy, heartbeat_s=heartbeat_s, run_heartbeat=True)
+        (router_url,), stop_router = serve_apps([router_app])
+        snap0 = obs_metrics.REGISTRY.snapshot()
+        before = [dict(e.stats) for e in engines]
+        results: list[dict] = []
+        res_lock = threading.Lock()
+
+        def run_session(i: int, start_delay: float):
+            time.sleep(max(0.0, start_delay))
+            tag = f"{policy}-{seed}-{i}"
+            system = f"[session {tag}] " + words(tag, system_chars)
+            history = ""
+            for t in range(turns):
+                question = words(f"{tag}-turn{t}", user_chars)
+                t0 = time.monotonic()
+                row = {"session": i, "turn": t, "ok": False,
+                       "ttft_ms": None}
+                try:
+                    with requests.post(
+                            f"{router_url}/generate",
+                            json={"question": question,
+                                  "context": system + history,
+                                  "use_knowledge_base": False,
+                                  "num_tokens": num_tokens},
+                            stream=True, timeout=300) as resp:
+                        if resp.status_code == 200:
+                            it = resp.iter_content(chunk_size=1)
+                            body = b""
+                            for b in it:
+                                body = b
+                                row["ttft_ms"] = \
+                                    (time.monotonic() - t0) * 1e3
+                                break
+                            for b in it:
+                                body += b
+                            answer = body.decode("utf-8", errors="replace")
+                            row["ok"] = "[error]" not in answer
+                            row["replica"] = resp.headers.get(
+                                "X-Routed-Replica", "")
+                            history += (f"\nUser: {question}"
+                                        f"\nAssistant: {answer}")
+                        else:
+                            row["status"] = resp.status_code
+                except requests.RequestException as exc:
+                    row["error"] = str(exc)
+                with res_lock:
+                    results.append(row)
+
+        rng = _np.random.RandomState(seed)
+        delays = _np.cumsum(rng.exponential(1.0 / session_rps,
+                                            size=sessions))
+        threads = [threading.Thread(target=run_session, args=(i, delays[i]),
+                                    daemon=True)
+                   for i in range(sessions)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=600)
+        stop_router()
+
+        snap1 = obs_metrics.REGISTRY.snapshot()
+        after = [dict(e.stats) for e in engines]
+
+        def _delta(key: str) -> float:
+            return snap1.get(key, 0.0) - snap0.get(key, 0.0)
+
+        hit = sum(a.get("prefix_cache_hit_tokens", 0)
+                  - b.get("prefix_cache_hit_tokens", 0)
+                  for a, b in zip(after, before))
+        lookup = sum(a.get("prefix_cache_lookup_tokens", 0)
+                     - b.get("prefix_cache_lookup_tokens", 0)
+                     for a, b in zip(after, before))
+        ok_rows = [r for r in results if r["ok"]]
+        ttfts = sorted(r["ttft_ms"] for r in ok_rows
+                       if r["ttft_ms"] is not None)
+        warm = sorted(r["ttft_ms"] for r in ok_rows
+                      if r["turn"] > 0 and r["ttft_ms"] is not None)
+        cold = sorted(r["ttft_ms"] for r in ok_rows
+                      if r["turn"] == 0 and r["ttft_ms"] is not None)
+        met = [r for r in ok_rows
+               if r["ttft_ms"] is not None and r["ttft_ms"] <= slo_ttft_ms]
+        placed = {f"r{i}": int(_delta(
+            f'router_placed_total{{replica="r{i}"}}'))
+            for i in range(len(replica_urls))}
+        return {
+            "policy": policy,
+            "offered_turns": sessions * turns,
+            "completed": len(ok_rows),
+            "errors": len(results) - len(ok_rows),
+            "slo_attainment": round(len(met) / max(1, sessions * turns), 4),
+            "ttft_p50_ms": (round(statistics.median(ttfts), 2)
+                            if ttfts else None),
+            "ttft_p99_ms": (round(
+                ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.99))], 2)
+                if ttfts else None),
+            "cold_ttft_p50_ms": (round(statistics.median(cold), 2)
+                                 if cold else None),
+            "warm_ttft_p50_ms": (round(statistics.median(warm), 2)
+                                 if warm else None),
+            "prefix_hit_tokens": int(hit),
+            "prefix_hit_rate": round(hit / lookup, 4) if lookup else 0.0,
+            "placed": placed,
+            "affinity_hit_placements": int(_delta("router_affinity_hits")),
+            "retries_connect": int(_delta(
+                'router_retries_total{reason="connect"}')),
+        }
+
+    replica_urls, stop_replicas = serve_apps(apps)
+    try:
+        policy_rows = []
+        for policy in policies:
+            for eng in engines:
+                try:
+                    # Fresh caches per policy: a later policy must not
+                    # ride (or fight eviction with) an earlier one's
+                    # pages. Content is policy-unique anyway; this keeps
+                    # pool pressure comparable too.
+                    eng.reset()
+                except Exception:  # noqa: BLE001 — comparability only
+                    pass
+            policy_rows.append(one_policy(policy, replica_urls))
+    finally:
+        stop_replicas()
+    return {
+        "replicas": len(engines),
+        "sessions": int(sessions),
+        "turns_per_session": int(turns),
+        "session_rps": float(session_rps),
+        "slo_ttft_ms": float(slo_ttft_ms),
+        "num_tokens": int(num_tokens),
+        "policies": policy_rows,
+    }
+
+
 def pipeline_snapshot(stats: dict) -> dict:
     """Overlapped harvest/dispatch pipeline summary from engine.stats:
     how long the harvest worker blocked per round/first readback — time
@@ -484,7 +750,8 @@ def assemble_result(*, kind, model, headline, engine_p50, engine_p99, tput,
                     e2e_dist, e2e_breakdown, pipeline, quant, kv_quant,
                     weights, prompt_len, out_len, slots, steps_per_round,
                     kv_pool_pages, device, rtt_ms, n_devices,
-                    bench_seconds, e2e_tps_p50=None, openloop=None) -> dict:
+                    bench_seconds, e2e_tps_p50=None, openloop=None,
+                    fleet=None) -> dict:
     """The bench's single output contract. Every field name here is
     pinned by tools/bench_schema.json (validated at emit time AND by the
     tier-1 suite, tests/test_bench_schema.py) so a rename fails fast
@@ -522,6 +789,12 @@ def assemble_result(*, kind, model, headline, engine_p50, engine_p99, tput,
         # sweep is not requested (closed-loop-only runs keep their
         # existing shape)
         "openloop": openloop,
+        # Multi-replica fleet scenario (BENCH_REPLICAS >= 2): Poisson
+        # session load through the router over N in-process replicas,
+        # affinity placement vs a round-robin baseline — cross-replica
+        # prefix_hit_rate and SLO attainment per policy. Null when the
+        # fleet is not requested.
+        "fleet": fleet,
         "quantization": quant,
         "kv_quant": kv_quant,
         "weights": weights,
@@ -897,6 +1170,36 @@ def main() -> None:
     finally:
         engine.stop()
 
+    # Fleet scenario (BENCH_REPLICAS >= 2): the router over N fresh
+    # in-process replicas sharing the measured model's params. Runs with
+    # the main engine STOPPED (its pool idle) and explicit small replica
+    # pools; prewarm's shrink-on-OOM absorbs tight-HBM hosts. Degrades
+    # to fleet=null, never aborts the bench.
+    fleet = None
+    n_rep = int(os.environ.get("BENCH_REPLICAS", "0") or 0)
+    if n_rep >= 2:
+        fleet_engines = []
+        try:
+            fleet_engines = build_fleet_engines(
+                engine.params, model_cfg, engine.tokenizer, n_rep)
+            fleet = run_fleet_bench(
+                fleet_engines,
+                sessions=int(os.environ.get("BENCH_FLEET_SESSIONS", "6")),
+                turns=int(os.environ.get("BENCH_FLEET_TURNS", "4")),
+                session_rps=float(os.environ.get(
+                    "BENCH_FLEET_SESSION_RPS", "2")),
+                slo_ttft_ms=float(os.environ.get(
+                    "BENCH_SLO_TTFT_MS", "2000")),
+                seed=int(os.environ.get("BENCH_SEED", "0")))
+        except Exception as exc:  # noqa: BLE001
+            sys.stderr.write(f"bench: fleet scenario failed: {exc}\n")
+        finally:
+            for e in fleet_engines:
+                try:
+                    e.stop()
+                except Exception:  # noqa: BLE001
+                    pass
+
     import jax
     # Headline = the full QA-chatbot path (BASELINE.json's north star is
     # the *chatbot* TTFT, not the engine-only number — VERDICT r3 weak
@@ -909,7 +1212,7 @@ def main() -> None:
         achieved_bw=achieved_bw, bw_util=bw_util, bw_steady=bw_steady,
         chat=chat, e2e_p50=e2e_p50, e2e_dist=e2e_dist,
         e2e_breakdown=e2e_breakdown, e2e_tps_p50=e2e_tps_p50,
-        pipeline=pipeline, openloop=openloop,
+        pipeline=pipeline, openloop=openloop, fleet=fleet,
         quant=quant, kv_quant=engine.cfg.kv_quant or None,
         weights=("real" if os.environ.get("BENCH_MODEL_PATH")
                  else "random-init"),

@@ -31,6 +31,19 @@ Robustness contract (docs/robustness.md):
 - per-request deadlines (``X-Deadline-Ms``, config/env default) ride the
   flight-recorder contextvar into the engine, which drops expired queued
   requests before prefill and stops decode when the deadline passes.
+
+Drain protocol (docs/router.md): ``POST /control/drain`` flips admission
+to reject-new — every work endpoint answers 429 + ``Retry-After`` with
+``type=draining`` while IN-FLIGHT streams run to completion — and
+``GET /health`` turns 503 so k8s readiness and the fleet router stop
+placing here. ``POST /control/undrain`` re-opens admission (rollback).
+``/health`` is truthful the same way when the ``chain_generate`` breaker
+is open: a replica that would fast-503 every generate is NOT ready, and
+the probe must say so instead of letting the router/k8s keep routing to
+it. The health body doubles as the router's heartbeat payload: a
+``load`` block with the edge's in-flight stream count and the engine's
+reject/deadline-drop counters (per-app state only — safe for N
+in-process replicas sharing one metrics registry).
 """
 
 from __future__ import annotations
@@ -41,6 +54,7 @@ import inspect
 import json
 import math
 import os
+import threading
 from typing import Optional
 
 from aiohttp import web
@@ -77,11 +91,46 @@ def _shed(reason: str) -> None:
         labelnames=("reason",)).labels(reason).inc()
 
 
-try:  # typed app-state key (aiohttp >= 3.9); tests reach the breaker by it
+class DrainState:
+    """Admission switch + in-flight stream accounting for one app.
+
+    ``draining`` flips via ``POST /control/drain``; ``in_flight`` counts
+    /generate streams between the chain generator starting and its
+    terminal transition (run_chain's finally — which runs on EVERY exit:
+    completion, mid-stream error, client disconnect), so a rollout can
+    watch it reach 0 before killing the process. Thread-safe: the
+    counter is bumped from chain worker threads while the flag flips
+    from the event loop (or test threads)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.draining = False
+        self._in_flight = 0
+
+    @property
+    def in_flight(self) -> int:
+        return self._in_flight
+
+    def inc(self) -> None:
+        with self._lock:
+            self._in_flight += 1
+
+    def dec(self) -> None:
+        with self._lock:
+            self._in_flight = max(0, self._in_flight - 1)
+
+    def set_draining(self, value: bool) -> None:
+        with self._lock:
+            self.draining = bool(value)
+
+
+try:  # typed app-state keys (aiohttp >= 3.9); tests reach them by these
     GENERATE_BREAKER = web.AppKey("generate_breaker",
                                   resilience.CircuitBreaker)
-except AttributeError:  # older aiohttp: plain string key
+    DRAIN_STATE = web.AppKey("drain_state", DrainState)
+except AttributeError:  # older aiohttp: plain string keys
     GENERATE_BREAKER = "generate_breaker"  # type: ignore[assignment]
+    DRAIN_STATE = "drain_state"  # type: ignore[assignment]
 
 
 def discover_example(spec: str) -> type[BaseExample]:
@@ -133,12 +182,76 @@ def create_app(example: BaseExample,
     breaker = resilience.CircuitBreaker(
         "chain_generate", rcfg.breaker_failures, rcfg.breaker_cooldown_s)
     app[GENERATE_BREAKER] = breaker
+    drain = DrainState()
+    app[DRAIN_STATE] = drain
+
+    def _load_block() -> dict:
+        """Per-replica load signals for the router heartbeat. Only
+        per-APP state (the drain counter, THIS example's engine) — the
+        process-wide metrics registry is shared when several replicas
+        run in one process (tests, fleet bench), so its counters cannot
+        tell replicas apart."""
+        load = {"in_flight": drain.in_flight}
+        engine = getattr(getattr(example, "llm", None), "engine", None)
+        if engine is not None:
+            try:
+                stats = engine.stats
+                load["queue_depth"] = int(
+                    stats.get("dispatch_queue_depth", 0))
+                # Admission-pressure counters: the router diffs these
+                # between heartbeats into a recent shed rate.
+                load["rejected_total"] = int(
+                    stats.get("rejected_full", 0)
+                    + stats.get("deadline_queue_drops", 0))
+                load["prefix_hit_rate"] = round(float(
+                    stats.get("prefix_cache_hit_rate", 0.0)), 4)
+            except Exception:  # noqa: BLE001 — health must never 500
+                logger.debug("engine stats unavailable", exc_info=True)
+        return load
 
     async def health(request: web.Request) -> web.Response:
-        return web.json_response({"status": "ok"})
+        # Readiness is TRUTHFUL: draining or a tripped generate breaker
+        # means every /generate would be rejected, so k8s and the fleet
+        # router must both see not-ready (503) — the two placement
+        # authorities can never disagree about this replica.
+        if drain.draining:
+            status, code = "draining", 503
+        elif breaker.state == resilience.OPEN:
+            status, code = "breaker_open", 503
+        else:
+            status, code = "ok", 200
+        return web.json_response(
+            {"status": status, "draining": drain.draining,
+             "breaker": breaker.state, "load": _load_block()},
+            status=code)
+
+    async def control_drain(request: web.Request) -> web.Response:
+        """Flip admission to reject-new; in-flight streams finish. The
+        k8s preStop hook POSTs here, then the rollout waits for
+        ``in_flight`` to reach 0 (deploy/README.md)."""
+        drain.set_draining(True)
+        logger.info("draining: admission closed, %d stream(s) in flight",
+                    drain.in_flight)
+        return web.json_response({"status": "draining",
+                                  "in_flight": drain.in_flight})
+
+    async def control_undrain(request: web.Request) -> web.Response:
+        drain.set_draining(False)
+        return web.json_response({"status": "ok",
+                                  "in_flight": drain.in_flight})
+
+    def _drain_reject(rid: str) -> web.Response:
+        _shed("draining")
+        return error_response(
+            429, "draining",
+            "replica is draining; retry against another replica", rid,
+            retry_after_s=1.0)
 
     @instrumented("upload_document")
     async def upload_document(request: web.Request) -> web.Response:
+        if drain.draining:
+            return _drain_reject(
+                obs_flight.adopt_request_id(request.headers))
         # reference: server.py:91-118 — save then ingest
         reader = await request.multipart()
         field = await reader.next()
@@ -194,6 +307,12 @@ def create_app(example: BaseExample,
         # /debug/requests, the engine's stream, and the slow-request
         # dump. Echoed back so callers can correlate without sending one.
         rid = obs_flight.adopt_request_id(request.headers)
+
+        # Drain gate FIRST: a draining replica admits nothing new (the
+        # 429 tells the router/caller to go elsewhere) while the streams
+        # already in flight below run to completion.
+        if drain.draining:
+            return _drain_reject(rid)
 
         # Breaker fast-path: a generation path that keeps failing is
         # DOWN — reject in microseconds instead of queueing doomed work
@@ -264,6 +383,7 @@ def create_app(example: BaseExample,
             token = obs_flight.bind(timeline)
             timer = obs_metrics.RequestTimer("chain_generate")
             emitted = False
+            drain.inc()
             try:
                 gen = (example.rag_chain(question, num_tokens) if use_kb
                        else example.llm_chain(context, question, num_tokens))
@@ -289,6 +409,7 @@ def create_app(example: BaseExample,
                     {"error": type(exc).__name__, "message": str(exc),
                      "request_id": rid}) + "\n\n")
             finally:
+                drain.dec()
                 timer.finish()
                 obs_flight.unbind(token)
                 # Engine-served requests were already completed at the
@@ -371,6 +492,9 @@ def create_app(example: BaseExample,
     @instrumented("document_search")
     async def document_search(request: web.Request) -> web.Response:
         # reference: server.py:145-159 — duck-typed document_search
+        if drain.draining:
+            return _drain_reject(
+                obs_flight.adopt_request_id(request.headers))
         body = await request.json()
         content = body.get("content", "")
         num_docs = int(body.get("num_docs", 4))
@@ -421,6 +545,8 @@ def create_app(example: BaseExample,
     app.router.add_post("/uploadDocument", upload_document)
     app.router.add_post("/generate", generate_answer)
     app.router.add_post("/documentSearch", document_search)
+    app.router.add_post("/control/drain", control_drain)
+    app.router.add_post("/control/undrain", control_undrain)
     return app
 
 

@@ -1,0 +1,166 @@
+"""CLI: serve the fleet router, or drain a replica for a rollout.
+
+Serve::
+
+    python -m generativeaiexamples_tpu.router serve \\
+        --replicas r0=http://chain-0:8081,r1=http://chain-1:8081 \\
+        --port 8080 [--policy affinity]
+
+Drain (what the k8s preStop hook runs — POST ``/control/drain`` on the
+replica, then poll its ``/health`` until the in-flight stream count
+reaches 0 or the wait budget expires)::
+
+    python -m generativeaiexamples_tpu.router drain \\
+        --url http://127.0.0.1:8081 --wait 120
+
+Undrain (rollback — re-open admission on a drained replica)::
+
+    python -m generativeaiexamples_tpu.router undrain \\
+        --url http://127.0.0.1:8081
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+from typing import Optional
+
+from ..utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def parse_replicas(spec: str) -> list[tuple[str, str]]:
+    """``name=url,name=url`` (or bare ``url,url`` → auto-named r0, r1…).
+
+    Duplicate names are a hard error: ``ReplicaTable.add`` is
+    last-writer-wins (the re-add/rollout story), so a collision —
+    including a bare URL auto-named into an explicit name — would
+    silently drop a replica from the fleet."""
+    out: list[tuple[str, str]] = []
+    seen: set[str] = set()
+    for i, entry in enumerate(e.strip() for e in spec.split(",")):
+        if not entry:
+            continue
+        if "=" in entry and not entry.startswith(("http://", "https://")):
+            name, _, url = entry.partition("=")
+            name, url = name.strip(), url.strip()
+        else:
+            name, url = f"r{i}", entry
+        if name in seen:
+            raise ValueError(f"duplicate replica name {name!r} in "
+                             f"--replicas (auto-named bare URLs use "
+                             f"their position: r0, r1, ...)")
+        seen.add(name)
+        out.append((name, url))
+    return out
+
+
+def drain(url: str, wait_s: float, poll_s: float = 1.0) -> int:
+    """Flip the replica to draining (``serving.client.drain_replica`` —
+    one implementation of the protocol), then wait for in-flight 0 by
+    polling ``/health`` (a drained replica answers 503, and that body
+    IS the signal the poll reads)."""
+    import requests
+
+    from ..serving.client import drain_replica
+
+    url = url.rstrip("/")
+    try:
+        body = drain_replica(url)
+    except requests.RequestException as exc:
+        print(f"drain: POST /control/drain failed: {exc}",
+              file=sys.stderr)
+        return 1
+    in_flight = int(body.get("in_flight", 0))
+    print(f"drain: admission closed, {in_flight} stream(s) in flight")
+    deadline = time.monotonic() + wait_s
+    while in_flight > 0 and time.monotonic() < deadline:
+        time.sleep(poll_s)
+        try:
+            health = requests.get(f"{url}/health", timeout=10.0).json()
+            in_flight = int((health.get("load") or {}).get(
+                "in_flight", in_flight))
+        except requests.RequestException as exc:
+            print(f"drain: health poll failed ({exc}); assuming drained")
+            return 0
+        except ValueError:
+            pass  # non-JSON health answer; keep the last known count
+    if in_flight > 0:
+        print(f"drain: {in_flight} stream(s) still in flight after "
+              f"{wait_s}s wait budget", file=sys.stderr)
+        return 2
+    print("drain: all in-flight streams finished")
+    return 0
+
+
+def undrain(url: str) -> int:
+    """Re-open admission on a drained replica (rollback)."""
+    import requests
+
+    from ..serving.client import undrain_replica
+
+    try:
+        body = undrain_replica(url.rstrip("/"))
+    except requests.RequestException as exc:
+        print(f"undrain: POST /control/undrain failed: {exc}",
+              file=sys.stderr)
+        return 1
+    print(f"undrain: admission reopened "
+          f"({body.get('in_flight', 0)} stream(s) in flight)")
+    return 0
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description="TPU RAG fleet router")
+    sub = parser.add_subparsers(dest="cmd")
+
+    serve_p = sub.add_parser("serve", help="run the router")
+    serve_p.add_argument("--replicas", default=os.environ.get(
+        "ROUTER_REPLICAS", ""), help="name=url,... or url,...")
+    serve_p.add_argument("--host", default="0.0.0.0")
+    serve_p.add_argument("--port", type=int, default=8080)
+    serve_p.add_argument("--policy", default=None,
+                         choices=("affinity", "round_robin"))
+
+    drain_p = sub.add_parser("drain", help="drain one replica (preStop)")
+    drain_p.add_argument("--url", required=True)
+    drain_p.add_argument("--wait", type=float, default=120.0,
+                         help="seconds to wait for in-flight streams")
+    drain_p.add_argument("--poll", type=float, default=1.0)
+
+    undrain_p = sub.add_parser(
+        "undrain", help="re-open admission on a drained replica")
+    undrain_p.add_argument("--url", required=True)
+
+    args = parser.parse_args(argv)
+    if args.cmd == "drain":
+        return drain(args.url, args.wait, args.poll)
+    if args.cmd == "undrain":
+        return undrain(args.url)
+    if args.cmd != "serve":
+        parser.print_help()
+        return 2
+
+    from aiohttp import web
+
+    from .server import create_router_app
+
+    try:
+        replicas = parse_replicas(args.replicas)
+    except ValueError as exc:
+        print(f"serve: {exc}", file=sys.stderr)
+        return 2
+    if not replicas:
+        print("serve: --replicas (or ROUTER_REPLICAS) is required",
+              file=sys.stderr)
+        return 2
+    app = create_router_app(replicas, policy=args.policy)
+    web.run_app(app, host=args.host, port=args.port)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,100 @@
+"""The fleet router's metric surface — one canonical table.
+
+Every metric the router publishes is declared here, name -> (kind,
+labelnames, help). ``docs/observability.md`` documents the same set in a
+table fenced by ``<!-- router-metrics:begin/end -->`` and
+``tools/check_metrics_docs.py`` enforces the two directions (a rename
+here orphans the docs loudly; a new gauge can't ship undocumented) —
+the same contract the engine gauge table has.
+
+The registry is the process-wide one from ``obs/metrics.py``: when the
+router runs in its own process these are simply its ``/metrics``; when
+tests or the fleet bench run router + N replicas in ONE process, the
+``router_*`` prefix keeps them distinct from the replicas' chain/engine
+metrics, and the replica-labeled children tell the replicas apart.
+"""
+
+from __future__ import annotations
+
+from ..obs import metrics as obs_metrics
+
+#: name -> (kind, labelnames, help). The checker keys off the names; the
+#: accessors below key off the whole row, so the two can never drift.
+ROUTER_METRICS: dict[str, tuple[str, tuple[str, ...], str]] = {
+    "router_replicas_healthy": (
+        "gauge", (),
+        "replicas currently placeable: reachable, not draining, breaker "
+        "not open"),
+    "router_replicas_total": (
+        "gauge", (), "replicas in the table, placeable or not"),
+    "router_placed_total": (
+        "counter", ("replica",),
+        "requests placed on each replica (post-retry final placement)"),
+    "router_affinity_hits": (
+        "counter", (),
+        "placements whose chosen replica matched >= 1 prefix block in "
+        "its affinity sketch"),
+    "router_retries_total": (
+        "counter", ("reason",),
+        "forward attempts abandoned and retried on another replica, by "
+        "reason: connect (connect-phase failure), draining (replica "
+        "429'd as draining), breaker_open (placement raced a breaker "
+        "trip)"),
+    "router_drain_in_flight": (
+        "gauge", (),
+        "in-flight streams still running on DRAINING replicas, summed "
+        "from heartbeats — a rollout waits for this to reach 0"),
+    "router_replica_queue_depth": (
+        "gauge", ("replica",),
+        "per-replica engine dispatch queue depth from the last "
+        "heartbeat"),
+    "router_replica_in_flight": (
+        "gauge", ("replica",),
+        "per-replica in-flight /generate streams from the last "
+        "heartbeat"),
+    "router_replica_rejected_total": (
+        "gauge", ("replica",),
+        "per-replica cumulative engine admission rejections "
+        "(queue-full + deadline queue drops) from the last heartbeat — "
+        "the router diffs consecutive heartbeats into a recent shed "
+        "rate for the load score"),
+    "router_replica_prefix_hit_rate": (
+        "gauge", ("replica",),
+        "per-replica engine prefix-cache hit rate from the last "
+        "heartbeat — fleet-wide cache health at a glance"),
+}
+
+
+def _get(name: str):
+    kind, labelnames, help_txt = ROUTER_METRICS[name]
+    reg = obs_metrics.REGISTRY
+    factory = reg.counter if kind == "counter" else reg.gauge
+    return factory(name, help_txt, labelnames=labelnames)
+
+
+def counter(name: str, *labels: str):
+    m = _get(name)
+    return m.labels(*labels) if labels else m
+
+
+def gauge(name: str, *labels: str):
+    m = _get(name)
+    return m.labels(*labels) if labels else m
+
+
+def record_replica_load(name: str, load: dict) -> None:
+    """Mirror one replica's heartbeat ``load`` block into the
+    replica-labeled gauges (obs/metrics stays scrape-shaped: the router
+    polls, the gauges hold the last observation)."""
+    if "queue_depth" in load:
+        gauge("router_replica_queue_depth", name).set(
+            float(load["queue_depth"]))
+    if "in_flight" in load:
+        gauge("router_replica_in_flight", name).set(
+            float(load["in_flight"]))
+    if "rejected_total" in load:
+        gauge("router_replica_rejected_total", name).set(
+            float(load["rejected_total"]))
+    if "prefix_hit_rate" in load:
+        gauge("router_replica_prefix_hit_rate", name).set(
+            float(load["prefix_hit_rate"]))

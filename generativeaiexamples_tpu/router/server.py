@@ -1,0 +1,512 @@
+"""Fleet router: the asyncio HTTP front that turns N chain-server/engine
+replicas into one serving endpoint.
+
+Request path (``POST /generate``, ``/documentSearch``, and the
+OpenAI-compat ``/v1/*`` surfaces):
+
+1. read the JSON body once, hash the prompt head into chained affinity
+   blocks (``table.affinity_blocks``);
+2. place via :class:`~.table.ReplicaTable` (affinity + load + health —
+   docs/router.md has the policy);
+3. forward the raw body with the caller's correlation headers
+   (``X-Request-ID``, ``X-Deadline-Ms``, ``traceparent``) intact;
+4. stream the replica's response back byte-for-byte.
+
+Failure semantics (the part routers get wrong):
+
+- **Connect-phase failures only are retried on the next replica** —
+  the PR-5 ``is_connect_failure`` contract: if the connection was never
+  established, the replica cannot have started generating, so a replay
+  cannot double-run a generation. One bounded budget
+  (``ROUTER_RETRY_ATTEMPTS``) across replicas; each failed attempt
+  feeds that replica's breaker.
+- A **429 ``draining``** answer is also safe to retry (the replica
+  refused before doing any work) and additionally marks the replica
+  draining immediately — the router need not wait for the next
+  heartbeat to stop placing on it.
+- **Mid-stream replica loss is never retried** (tokens already went
+  out on a 200). The router appends the chain server's machine-readable
+  error-frame contract (``\\n[error] ...`` + ``event: error`` JSON with
+  ``type=replica_lost``) so clients parse a real failure instead of
+  seeing a silent truncation, records the breaker failure, and marks
+  the replica unreachable so the NEXT request places elsewhere at once.
+- Any other upstream HTTP status is relayed as-is — the replica's 429 /
+  503 / 504 taxonomy (docs/robustness.md) already says the right thing;
+  the router adds only ``503 no_replicas`` (nothing placeable) and
+  ``502 replica_error`` (retry budget exhausted).
+
+A background **heartbeat** polls each replica's ``GET /health`` every
+``ROUTER_HEARTBEAT_S``: the chain server's truthful readiness body
+(drain state, breaker state, and the ``load`` block) is the router's
+entire fleet view — no engine or metrics-scrape coupling. Fault points
+``router.forward`` / ``replica.heartbeat`` (tag = replica name) let
+chaos plans fail or partition individual replicas (docs/robustness.md).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+from typing import Optional, Sequence
+
+import aiohttp
+from aiohttp import web
+
+from ..obs import flight as obs_flight
+from ..utils import faults
+from ..utils.logging import get_logger
+from . import metrics as router_metrics
+from .table import ReplicaTable
+
+logger = get_logger(__name__)
+
+#: Paths the router forwards, mapped to how the affinity text is pulled
+#: out of the JSON body. The affinity text is the PROMPT HEAD as the
+#: replica will see it lead — context/system first, then the question —
+#: so a multi-turn session keeps hashing to the same leading blocks.
+FORWARD_PATHS = ("/generate", "/documentSearch", "/v1/completions",
+                 "/v1/chat/completions", "/v1/embeddings")
+
+#: Correlation/robustness headers forwarded verbatim to the replica.
+_FORWARD_HEADERS = ("X-Request-ID", "X-Deadline-Ms", "traceparent",
+                    "Content-Type", "Accept")
+
+#: Replica response headers relayed back to the caller.
+_RELAY_HEADERS = ("Content-Type", "X-Request-ID", "Retry-After",
+                  "Cache-Control")
+
+
+def affinity_text(path: str, body: dict) -> str:
+    """The text whose head determines placement, per forwarded route."""
+    if path == "/generate":
+        context = str(body.get("context", "") or "")
+        question = str(body.get("question", "") or "")
+        return f"{context}\n{question}" if context else question
+    if path == "/v1/completions":
+        prompt = body.get("prompt", "")
+        return "\n".join(map(str, prompt)) if isinstance(prompt, list) \
+            else str(prompt)
+    if path == "/v1/chat/completions":
+        msgs = body.get("messages") or []
+        return "\n".join(str(m.get("content", "")) for m in msgs
+                         if isinstance(m, dict))
+    if path == "/v1/embeddings":
+        inp = body.get("input", "")
+        return "\n".join(map(str, inp)) if isinstance(inp, list) \
+            else str(inp)
+    return str(body.get("content", ""))  # /documentSearch
+
+
+def is_connect_failure(exc: BaseException) -> bool:
+    """aiohttp twin of ``serving.client.is_connect_failure``: True only
+    when the failure happened ESTABLISHING the connection, so the
+    request cannot have executed replica-side. ``ServerDisconnectedError``
+    and payload errors arrive after the connection existed — the replica
+    may have done the work; never replayed."""
+    if isinstance(exc, (aiohttp.ClientConnectorError,
+                        ConnectionRefusedError)):
+        return True
+    if isinstance(exc, ConnectionError):
+        # exact builtin type only (incl. injected faults): subclasses
+        # Reset/Aborted/BrokenPipe mean bytes were in flight
+        return type(exc) is ConnectionError
+    return False
+
+
+def _error_response(status: int, err_type: str, message: str, rid: str,
+                    retry_after_s: Optional[float] = None) -> web.Response:
+    headers = {"X-Request-ID": rid}
+    if retry_after_s is not None:
+        headers["Retry-After"] = str(max(1, int(retry_after_s + 0.999)))
+    return web.json_response(
+        {"error": {"type": err_type, "message": message},
+         "request_id": rid},
+        status=status, headers=headers)
+
+
+class FleetRouter:
+    """Owns the table, the client session, and the heartbeat task."""
+
+    def __init__(self, table: ReplicaTable, *,
+                 heartbeat_s: float = 2.0,
+                 heartbeat_timeout_s: float = 2.0,
+                 retry_attempts: int = 3,
+                 connect_timeout_s: float = 5.0,
+                 forward_timeout_s: float = 300.0):
+        self.table = table
+        self.heartbeat_s = float(heartbeat_s)
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self.retry_attempts = max(1, int(retry_attempts))
+        self.connect_timeout_s = float(connect_timeout_s)
+        self.forward_timeout_s = float(forward_timeout_s)
+        self._session: Optional[aiohttp.ClientSession] = None
+        self._hb_task: Optional[asyncio.Task] = None
+
+    # ---------------------------------------------------------- lifecycle
+
+    async def start(self, run_heartbeat: bool = True) -> None:
+        if self._session is None:
+            self._session = aiohttp.ClientSession()
+        if run_heartbeat and self._hb_task is None:
+            self._hb_task = asyncio.create_task(self._heartbeat_loop())
+
+    async def stop(self) -> None:
+        if self._hb_task is not None:
+            self._hb_task.cancel()
+            try:
+                await self._hb_task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+            self._hb_task = None
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+    # ---------------------------------------------------------- heartbeat
+
+    async def _heartbeat_loop(self) -> None:
+        while True:
+            try:
+                await self.heartbeat_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — the loop must survive
+                logger.exception("router heartbeat cycle failed")
+            await asyncio.sleep(self.heartbeat_s)
+
+    async def heartbeat_once(self) -> None:
+        """Probe every replica's /health concurrently; apply results."""
+        reps = self.table.replicas()
+        if not reps:
+            return
+        await asyncio.gather(*(self._probe(r) for r in reps))
+
+    async def _probe(self, rep) -> None:
+        try:
+            faults.inject("replica.heartbeat", tag=rep.name)
+            assert self._session is not None
+            async with self._session.get(
+                    rep.url + "/health",
+                    timeout=aiohttp.ClientTimeout(
+                        total=self.heartbeat_timeout_s)) as resp:
+                try:
+                    body = await resp.json()
+                except Exception:  # noqa: BLE001 — non-JSON health answer
+                    body = None
+                self.table.update_health(
+                    rep.name, ok=True, ready=resp.status == 200, body=body)
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:  # noqa: BLE001 — any probe failure
+            logger.debug("heartbeat to %s failed: %s", rep.name, exc)
+            self.table.update_health(rep.name, ok=False, ready=False)
+
+    # ------------------------------------------------------------ forward
+
+    async def forward(self, request: web.Request) -> web.StreamResponse:
+        raw = await request.read()
+        try:
+            body = json.loads(raw) if raw else {}
+        except (ValueError, UnicodeDecodeError):
+            body = {}
+        blocks = self.table.affinity_blocks(
+            affinity_text(request.path, body if isinstance(body, dict)
+                          else {}))
+        rid = obs_flight.adopt_request_id(request.headers)
+        fwd_headers = {"X-Request-ID": rid}
+        for h in _FORWARD_HEADERS:
+            if h in request.headers and h not in fwd_headers:
+                fwd_headers[h] = request.headers[h]
+
+        tried: list[str] = []
+        last_err: Optional[str] = None
+        fallback: Optional[web.Response] = None
+        for _ in range(self.retry_attempts):
+            rep = self.table.place(blocks, exclude=tried)
+            if rep is None:
+                break
+            tried.append(rep.name)
+            try:
+                faults.inject("router.forward", tag=rep.name)
+                assert self._session is not None
+                upstream = await self._session.post(
+                    rep.url + request.path, data=raw, headers=fwd_headers,
+                    timeout=aiohttp.ClientTimeout(
+                        total=self.forward_timeout_s,
+                        sock_connect=self.connect_timeout_s))
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:  # noqa: BLE001 — classified below
+                if not is_connect_failure(exc):
+                    # The connection existed; the replica may have run
+                    # the request. Never replayed (PR-5 semantics).
+                    rep.breaker.record_failure()
+                    logger.warning("forward to %s failed post-connect: %s",
+                                   rep.name, exc)
+                    return _error_response(
+                        502, "replica_error",
+                        f"replica {rep.name} failed: {exc}", rid)
+                rep.breaker.record_failure()
+                router_metrics.counter(
+                    "router_retries_total", "connect").inc()
+                last_err = f"{rep.name}: {exc}"
+                logger.info("connect to replica %s failed (%s); trying "
+                            "next", rep.name, exc)
+                continue
+            try:
+                return await self._relay(request, rep, upstream, rid,
+                                         blocks, tried)
+            except _RetryNextReplica as retry:
+                last_err = f"{rep.name}: {retry.reason}"
+                fallback = retry.response
+                continue
+        if fallback is not None:
+            # Every placeable replica refused as draining: relay the 429
+            # — a rollout must look like backpressure to callers
+            # (Retry-After and all), never a hard 502.
+            return fallback
+        if not tried:
+            return _error_response(
+                503, "no_replicas",
+                "no placeable replica (all draining, unreachable, or "
+                "breaker-open)", rid, retry_after_s=self.heartbeat_s)
+        return _error_response(
+            502, "replica_error",
+            f"all forward attempts failed (tried {', '.join(tried)}); "
+            f"last: {last_err}", rid, retry_after_s=self.heartbeat_s)
+
+    async def _relay(self, request: web.Request, rep,
+                     upstream: aiohttp.ClientResponse, rid: str,
+                     blocks: Sequence[bytes],
+                     tried: Sequence[str]) -> web.StreamResponse:
+        """Stream one upstream answer back; raises _RetryNextReplica for
+        the one retry-safe HTTP answer (429 draining, pre-work)."""
+        try:
+            if upstream.status == 429:
+                data = await upstream.read()
+                err_type = ""
+                try:
+                    err_type = json.loads(data)["error"]["type"]
+                except Exception:  # noqa: BLE001 — not the JSON contract
+                    pass
+                if err_type == "draining":
+                    # The replica refused BEFORE doing any work, so a
+                    # sibling can safely take it; stop placing here now
+                    # instead of at the next heartbeat. The rendered 429
+                    # rides along as the fallback answer for when no
+                    # sibling remains.
+                    self.table.mark_draining(rep.name)
+                    rep.breaker.record_success()  # alive — just draining
+                    router_metrics.counter(
+                        "router_retries_total", "draining").inc()
+                    raise _RetryNextReplica(
+                        "draining",
+                        response=self._relay_body(upstream, data))
+                # Genuine backpressure (queue_full, deadline_unmeetable):
+                # relay — the Retry-After hint is the replica's to give.
+                return self._relay_body(upstream, data)
+            rep.breaker.record_success()
+            if upstream.status >= 400:
+                return self._relay_body(upstream, await upstream.read())
+            # 2xx: commit the placement (the sketch learns this prompt)
+            # and stream the body through as it arrives.
+            self.table.record_placement(rep, blocks)
+            resp = web.StreamResponse(status=upstream.status)
+            for h in _RELAY_HEADERS:
+                if h in upstream.headers:
+                    resp.headers[h] = upstream.headers[h]
+            resp.headers["X-Routed-Replica"] = rep.name
+            await resp.prepare(request)
+            # Upstream reads and downstream writes fail for OPPOSITE
+            # reasons and must not share an except: a read failure is
+            # the REPLICA dying (breaker + unreachable + error frame); a
+            # write failure is the CALLER hanging up, which says nothing
+            # about the replica's health — misfiling it would let a few
+            # impatient clients trip a healthy replica's breaker.
+            chunks = upstream.content.iter_any()
+            while True:
+                try:
+                    chunk = await chunks.__anext__()
+                except StopAsyncIteration:
+                    break
+                except (aiohttp.ClientError, ConnectionError,
+                        asyncio.TimeoutError) as exc:
+                    # Replica died mid-stream: tokens already went out
+                    # on a 200, so NO retry — degrade with the
+                    # machine-readable error frame (chat_client parses
+                    # it into last_error) and stop placing here
+                    # immediately.
+                    rep.breaker.record_failure()
+                    self.table.mark_unreachable(rep.name)
+                    logger.warning("replica %s lost mid-stream: %s",
+                                   rep.name, exc)
+                    frame = (f"\n[error] replica {rep.name} lost "
+                             f"mid-stream"
+                             + "\n\nevent: error\ndata: " + json.dumps(
+                                 {"error": "replica_lost",
+                                  "message": f"replica {rep.name} lost "
+                                             f"mid-stream: {exc}",
+                                  "replica": rep.name,
+                                  "request_id": rid}) + "\n\n")
+                    try:
+                        await resp.write(frame.encode("utf-8"))
+                    except (ConnectionError, ConnectionResetError):
+                        pass  # caller gone too
+                    break
+                try:
+                    await resp.write(chunk)
+                except (ConnectionError, ConnectionResetError) as exc:
+                    logger.debug("caller disconnected mid-stream: %s",
+                                 exc)
+                    # Abort the upstream stream (don't drain it): the
+                    # replica sees the disconnect and cancels the
+                    # generation instead of decoding to a dead socket.
+                    upstream.close()
+                    break
+            try:
+                await resp.write_eof()
+            except (ConnectionError, ConnectionResetError):
+                pass
+            return resp
+        finally:
+            upstream.release()
+
+    @staticmethod
+    def _relay_body(upstream: aiohttp.ClientResponse,
+                    data: Optional[bytes] = None) -> web.Response:
+        headers = {h: upstream.headers[h] for h in _RELAY_HEADERS
+                   if h in upstream.headers}
+        # web.Response sets Content-Type via its own keyword; passing it
+        # in headers too raises.
+        ctype = headers.pop("Content-Type", "application/octet-stream")
+        return web.Response(status=upstream.status, body=data or b"",
+                            content_type=ctype.split(";")[0],
+                            headers=headers)
+
+
+class _RetryNextReplica(Exception):
+    def __init__(self, reason: str,
+                 response: Optional[web.Response] = None):
+        super().__init__(reason)
+        self.reason = reason
+        self.response = response  # relayed if no sibling can take it
+
+
+try:  # typed app-state key (aiohttp >= 3.9); tests reach the router by it
+    ROUTER = web.AppKey("fleet_router", FleetRouter)
+except AttributeError:  # older aiohttp: plain string key
+    ROUTER = "fleet_router"  # type: ignore[assignment]
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def create_router_app(replicas: Sequence[tuple[str, str]] = (), *,
+                      table: Optional[ReplicaTable] = None,
+                      policy: Optional[str] = None,
+                      heartbeat_s: Optional[float] = None,
+                      retry_attempts: Optional[int] = None,
+                      run_heartbeat: bool = True) -> web.Application:
+    """Build the router app. ``replicas`` is (name, url) pairs; pass a
+    pre-built ``table`` instead to control scoring knobs. Env defaults:
+    ``ROUTER_POLICY``, ``ROUTER_HEARTBEAT_S``, ``ROUTER_RETRY_ATTEMPTS``,
+    ``ROUTER_AFFINITY_BLOCK_BYTES`` / ``ROUTER_AFFINITY_HEAD_BYTES`` /
+    ``ROUTER_SKETCH_CAP``, ``ROUTER_BREAKER_FAILURES`` /
+    ``ROUTER_BREAKER_COOLDOWN_S``, ``ROUTER_CONNECT_TIMEOUT_S`` /
+    ``ROUTER_FORWARD_TIMEOUT_S`` (docs/router.md)."""
+    if table is None:
+        table = ReplicaTable(
+            policy=policy or os.environ.get("ROUTER_POLICY", "affinity"),
+            block_bytes=int(_env_float("ROUTER_AFFINITY_BLOCK_BYTES", 64)),
+            head_bytes=int(_env_float("ROUTER_AFFINITY_HEAD_BYTES", 4096)),
+            sketch_cap=int(_env_float("ROUTER_SKETCH_CAP", 2048)),
+            breaker_failures=int(_env_float("ROUTER_BREAKER_FAILURES", 3)),
+            breaker_cooldown_s=_env_float("ROUTER_BREAKER_COOLDOWN_S", 10))
+    elif policy is not None:
+        table.policy = policy
+    for name, url in replicas:
+        table.add(name, url)
+    router = FleetRouter(
+        table,
+        heartbeat_s=(heartbeat_s if heartbeat_s is not None
+                     else _env_float("ROUTER_HEARTBEAT_S", 2.0)),
+        heartbeat_timeout_s=_env_float("ROUTER_HEARTBEAT_TIMEOUT_S", 2.0),
+        retry_attempts=(retry_attempts if retry_attempts is not None
+                        else int(_env_float("ROUTER_RETRY_ATTEMPTS", 3))),
+        connect_timeout_s=_env_float("ROUTER_CONNECT_TIMEOUT_S", 5.0),
+        forward_timeout_s=_env_float("ROUTER_FORWARD_TIMEOUT_S", 300.0))
+
+    app = web.Application(client_max_size=100 * 1024 ** 2)
+    app[ROUTER] = router
+
+    async def health(request: web.Request) -> web.Response:
+        reps = table.snapshot()
+        healthy = sum(1 for r in reps if r["placeable"])
+        return web.json_response(
+            {"status": "ok" if healthy else "no_replicas",
+             "replicas_healthy": healthy, "replicas_total": len(reps)},
+            status=200 if healthy else 503)
+
+    async def metrics_endpoint(request: web.Request) -> web.Response:
+        from ..obs import metrics as obs_metrics
+        return web.Response(text=obs_metrics.REGISTRY.render_prometheus(),
+                            content_type="text/plain")
+
+    async def list_replicas(request: web.Request) -> web.Response:
+        return web.json_response({"replicas": table.snapshot(),
+                                  "policy": table.policy})
+
+    async def control_replicas(request: web.Request) -> web.Response:
+        """Runtime table edits — the rollout story's API:
+        ``{"op": "add", "name": "r2", "url": "http://..."}`` /
+        ``{"op": "remove", "name": "r2"}``. New replicas receive traffic
+        after their first successful heartbeat."""
+        body = await request.json()
+        op, name = body.get("op"), body.get("name", "")
+        if op == "add":
+            if not name or not body.get("url"):
+                raise web.HTTPUnprocessableEntity(
+                    text="add needs 'name' and 'url'")
+            rep = table.add(name, body["url"])
+            # Probe now: an added replica that is already up starts
+            # taking traffic without waiting a full heartbeat period.
+            await router._probe(rep)
+            return web.json_response({"status": "added",
+                                      "replica": rep.snapshot()})
+        if op == "remove":
+            found = table.remove(name)
+            return web.json_response(
+                {"status": "removed" if found else "absent"},
+                status=200 if found else 404)
+        raise web.HTTPUnprocessableEntity(text="op must be add|remove")
+
+    async def control_heartbeat(request: web.Request) -> web.Response:
+        """Force one heartbeat cycle now (ops/tests)."""
+        await router.heartbeat_once()
+        return web.json_response({"replicas": table.snapshot()})
+
+    async def forward(request: web.Request) -> web.StreamResponse:
+        return await router.forward(request)
+
+    app.router.add_get("/health", health)
+    app.router.add_get("/metrics", metrics_endpoint)
+    app.router.add_get("/router/replicas", list_replicas)
+    app.router.add_post("/control/replicas", control_replicas)
+    app.router.add_post("/control/heartbeat", control_heartbeat)
+    for path in FORWARD_PATHS:
+        app.router.add_post(path, forward)
+
+    async def on_startup(app_: web.Application) -> None:
+        await router.start(run_heartbeat=run_heartbeat)
+
+    async def on_cleanup(app_: web.Application) -> None:
+        await router.stop()
+
+    app.on_startup.append(on_startup)
+    app.on_cleanup.append(on_cleanup)
+    return app

@@ -1,0 +1,303 @@
+"""Replica table: prefix-affinity sketches, load/health state, placement.
+
+The placement problem (Preble's prompt-aware scheduling, Mooncake's
+KV-centric routing, adapted to this stack): PR 1's prefix cache makes a
+replica that has *seen* a conversation's prefix much cheaper for its
+next turn than a cold sibling — so the router must send shared-prefix
+traffic back to the replica whose KV pages it warms, without starving
+load balance or placing onto a draining/dead replica.
+
+Three signals, combined per candidate replica:
+
+- **Affinity** — a router-side copy of the PR-1 chained block hash
+  (``engine/prefix_cache.hash_blocks``), computed over the UTF-8 bytes
+  of the request's prompt head instead of token ids (the router has no
+  tokenizer; it only needs *consistency with itself*, and byte-block
+  chaining has the same property that equal hash prefixes mean equal
+  text prefixes). Each replica carries a bounded-LRU **sketch** of the
+  block hashes of prompts recently placed on it — learned passively
+  from the router's own successful placements; the engine API is
+  untouched. The affinity score is the number of LEADING blocks of the
+  incoming prompt found in the sketch — exactly the prefix the
+  replica's engine-side cache can serve without prefill.
+- **Load** — dispatch queue depth, in-flight edge streams, and the
+  recent admission-rejection rate (the diff of the heartbeat's
+  cumulative ``rejected_total`` between polls), all from the replica's
+  ``/health`` heartbeat payload (chains/server.py ``_load_block``).
+- **Health** — a per-replica :class:`~..utils.resilience.CircuitBreaker`
+  fed by the router's own forward outcomes, plus heartbeat-observed
+  ``draining``/unreachable state. Draining, unreachable, or
+  breaker-open replicas are never placed.
+
+Everything here is synchronous and lock-guarded — callable from the
+router's event loop, bench threads, and chaos tests concurrently
+(the add/remove-while-placing race is pinned by tests/test_router.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from ..engine.prefix_cache import BlockHash, hash_blocks
+from ..utils import resilience
+from ..utils.logging import get_logger
+from . import metrics as router_metrics
+
+logger = get_logger(__name__)
+
+#: Placement policies. ``affinity`` is the production default;
+#: ``round_robin`` ignores both affinity and load (the bench baseline —
+#: what the affinity headline is measured against).
+POLICIES = ("affinity", "round_robin")
+
+
+def affinity_blocks(text: str, block_bytes: int = 64,
+                    head_bytes: int = 4096) -> list[BlockHash]:
+    """Chained block hashes of the prompt HEAD's UTF-8 bytes.
+
+    Reuses the engine's ``hash_blocks`` with bytes standing in for token
+    ids — chaining gives the same invariant (equal leading hashes ⇔
+    equal leading text), and capping at ``head_bytes`` bounds the cost:
+    shared-prefix affinity lives at the *front* of the prompt (system
+    prompt + early turns); differentiating tails add nothing."""
+    data = text.encode("utf-8", errors="replace")[:head_bytes]
+    return hash_blocks(list(data), block_bytes)
+
+
+@dataclass
+class Replica:
+    name: str
+    url: str
+    breaker: resilience.CircuitBreaker
+    reachable: bool = True      # the last heartbeat got an HTTP answer
+    ready: bool = True          # ... and it was a 200 (drain/breaker -> 503)
+    draining: bool = False
+    load: dict = field(default_factory=dict)
+    recent_rejects: float = 0.0    # rejected_total diff between heartbeats
+    last_heartbeat_t: float = 0.0
+    placements: int = 0            # committed placements (the metric)
+    selections: int = 0            # place() picks — bumped at decision
+    #                                time, under the table lock, so
+    #                                concurrent requests can't all pick
+    #                                the same replica before any commits
+    # Affinity sketch: block hash -> recency tick (insertion-ordered dict
+    # as LRU). Bounded; evicts oldest.
+    sketch: dict = field(default_factory=dict)
+
+    def placeable(self) -> bool:
+        return (self.reachable and self.ready and not self.draining
+                and self.breaker.state != resilience.OPEN)
+
+    def snapshot(self) -> dict:
+        return {
+            "name": self.name, "url": self.url,
+            "reachable": self.reachable, "ready": self.ready,
+            "draining": self.draining,
+            "breaker": self.breaker.state, "placeable": self.placeable(),
+            "load": dict(self.load),
+            "recent_rejects": self.recent_rejects,
+            "placements": self.placements,
+            "sketch_blocks": len(self.sketch),
+            "heartbeat_age_s": (round(time.monotonic()
+                                      - self.last_heartbeat_t, 3)
+                                if self.last_heartbeat_t else None),
+        }
+
+
+class ReplicaTable:
+    """The router's authoritative replica set + placement scorer."""
+
+    def __init__(self, *, policy: str = "affinity",
+                 block_bytes: int = 64, head_bytes: int = 4096,
+                 sketch_cap: int = 2048,
+                 affinity_weight: float = 2.0,
+                 queue_weight: float = 1.0,
+                 inflight_weight: float = 0.5,
+                 shed_weight: float = 1.0,
+                 breaker_failures: int = 3,
+                 breaker_cooldown_s: float = 10.0):
+        if policy not in POLICIES:
+            raise ValueError(f"unknown router policy {policy!r} "
+                             f"(known: {', '.join(POLICIES)})")
+        self.policy = policy
+        self.block_bytes = int(block_bytes)
+        self.head_bytes = int(head_bytes)
+        self.sketch_cap = int(sketch_cap)
+        self.affinity_weight = float(affinity_weight)
+        self.queue_weight = float(queue_weight)
+        self.inflight_weight = float(inflight_weight)
+        self.shed_weight = float(shed_weight)
+        self._breaker_failures = int(breaker_failures)
+        self._breaker_cooldown_s = float(breaker_cooldown_s)
+        self._lock = threading.Lock()
+        self._replicas: dict[str, Replica] = {}
+
+    # ------------------------------------------------------------ members
+
+    def add(self, name: str, url: str) -> Replica:
+        """Add (or re-add) a replica. Re-adding an existing name resets
+        its state — the rollout story: a replaced pod comes back clean."""
+        rep = Replica(
+            name=name, url=url.rstrip("/"),
+            # Private breaker instance (not the shared registry): each
+            # replica's failure count is its own; state still lands on
+            # /metrics under breaker_state{name="replica_<name>"}.
+            breaker=resilience.CircuitBreaker(
+                f"replica_{name}", self._breaker_failures,
+                self._breaker_cooldown_s))
+        with self._lock:
+            self._replicas[name] = rep
+        self._publish_counts()
+        logger.info("router: replica %s -> %s added", name, rep.url)
+        return rep
+
+    def remove(self, name: str) -> bool:
+        with self._lock:
+            found = self._replicas.pop(name, None) is not None
+        self._publish_counts()
+        if found:
+            logger.info("router: replica %s removed", name)
+        return found
+
+    def get(self, name: str) -> Optional[Replica]:
+        with self._lock:
+            return self._replicas.get(name)
+
+    def replicas(self) -> list[Replica]:
+        with self._lock:
+            return list(self._replicas.values())
+
+    def snapshot(self) -> list[dict]:
+        return [r.snapshot() for r in self.replicas()]
+
+    # ----------------------------------------------------------- affinity
+
+    def affinity_blocks(self, text: str) -> list[BlockHash]:
+        return affinity_blocks(text, self.block_bytes, self.head_bytes)
+
+    def _match(self, rep: Replica, blocks: Sequence[BlockHash]) -> int:
+        """Leading blocks of ``blocks`` present in the replica's sketch —
+        the contiguous shared prefix its engine cache can plausibly
+        serve. Chained hashes make any gap a hard stop: block k in the
+        sketch without block k-1 belongs to a different prefix."""
+        n = 0
+        for h in blocks:
+            if h not in rep.sketch:
+                break
+            n += 1
+        return n
+
+    def record_placement(self, rep: Replica,
+                         blocks: Sequence[BlockHash]) -> int:
+        """Commit a successful placement: learn the prompt's blocks into
+        the replica's sketch (LRU refresh), bump counters. Returns the
+        affinity match the placement had (for the hit counter)."""
+        with self._lock:
+            matched = self._match(rep, blocks)
+            for h in blocks:
+                rep.sketch.pop(h, None)     # refresh recency
+                rep.sketch[h] = None
+            while len(rep.sketch) > self.sketch_cap:
+                rep.sketch.pop(next(iter(rep.sketch)))
+            rep.placements += 1
+        router_metrics.counter("router_placed_total", rep.name).inc()
+        if matched:
+            router_metrics.counter("router_affinity_hits").inc()
+        return matched
+
+    # ---------------------------------------------------------- placement
+
+    def _score(self, rep: Replica, blocks: Sequence[BlockHash]) -> float:
+        load = rep.load
+        penalty = (self.queue_weight * float(load.get("queue_depth", 0))
+                   + self.inflight_weight * float(load.get("in_flight", 0))
+                   + self.shed_weight * rep.recent_rejects)
+        return self.affinity_weight * self._match(rep, blocks) - penalty
+
+    def place(self, blocks: Sequence[BlockHash] = (),
+              exclude: Sequence[str] = ()) -> Optional[Replica]:
+        """Choose the replica for a request whose prompt head hashes to
+        ``blocks``. ``exclude`` names replicas already tried this
+        request (the retry loop). Returns None when no placeable replica
+        remains — the caller's 503."""
+        with self._lock:
+            candidates = [r for r in self._replicas.values()
+                          if r.name not in exclude and r.placeable()]
+            if not candidates:
+                return None
+            if self.policy == "round_robin":
+                chosen = min(candidates,
+                             key=lambda r: (r.selections, r.name))
+            else:
+                # Max score; ties rotate to the least-selected candidate
+                # so a no-affinity workload degenerates to
+                # least-loaded-then-RR instead of pinning the
+                # dict-order-first replica.
+                chosen = max(candidates,
+                             key=lambda r: (self._score(r, blocks),
+                                            -r.selections, r.name))
+            chosen.selections += 1
+            return chosen
+
+    # ------------------------------------------------------------- health
+
+    def update_health(self, name: str, *, ok: bool, ready: bool = True,
+                      body: Optional[dict] = None) -> None:
+        """Apply one heartbeat observation. ``ok`` is reachability (the
+        probe got an HTTP answer at all); ``ready`` is whether that
+        answer was a 200 (the chain server 503s while draining or
+        breaker-open — readiness truthfulness); the body's ``draining``
+        / ``load`` fields refine it. A replica whose probe failed is
+        unplaceable IMMEDIATELY — within one heartbeat of a kill,
+        placement has stopped."""
+        with self._lock:
+            rep = self._replicas.get(name)
+            if rep is None:
+                return
+            rep.last_heartbeat_t = time.monotonic()
+            rep.reachable = ok
+            rep.ready = ok and ready
+            if ok and body is not None:
+                rep.draining = bool(body.get("draining", False))
+                load = body.get("load") or {}
+                # recent_rejects is a between-heartbeats DIFF, so the
+                # first observation is baseline only — a long-running
+                # replica's lifetime rejected_total must not count as
+                # "recent" shed and sink its placement score.
+                prev = rep.load.get("rejected_total")
+                if prev is None:
+                    rep.recent_rejects = 0.0
+                else:
+                    cur = float(load.get("rejected_total", prev))
+                    rep.recent_rejects = max(0.0, cur - float(prev))
+                rep.load = dict(load)
+        if ok and body is not None:
+            router_metrics.record_replica_load(name, body.get("load") or {})
+        self._publish_counts()
+
+    def mark_unreachable(self, name: str) -> None:
+        with self._lock:
+            rep = self._replicas.get(name)
+            if rep is not None:
+                rep.reachable = False
+        self._publish_counts()
+
+    def mark_draining(self, name: str, value: bool = True) -> None:
+        with self._lock:
+            rep = self._replicas.get(name)
+            if rep is not None:
+                rep.draining = bool(value)
+        self._publish_counts()
+
+    def _publish_counts(self) -> None:
+        with self._lock:
+            reps = list(self._replicas.values())
+            healthy = sum(1 for r in reps if r.placeable())
+            drain_in_flight = sum(
+                int(r.load.get("in_flight", 0)) for r in reps if r.draining)
+        router_metrics.gauge("router_replicas_total").set(len(reps))
+        router_metrics.gauge("router_replicas_healthy").set(healthy)
+        router_metrics.gauge("router_drain_in_flight").set(drain_in_flight)

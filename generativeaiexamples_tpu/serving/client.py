@@ -70,6 +70,27 @@ def post_with_retry(url: str, **kw) -> requests.Response:
                       should_retry=is_connect_failure)
 
 
+def drain_replica(url: str, timeout: float = 10.0) -> dict:
+    """Flip a chain-server replica to reject-new admission
+    (``POST /control/drain``, docs/router.md). Returns the server's
+    ``{"status": "draining", "in_flight": N}`` so rollout tooling can
+    poll ``/health`` until the in-flight count reaches 0 before killing
+    the process (the k8s preStop hook runs the same protocol via
+    ``python -m generativeaiexamples_tpu.router drain``)."""
+    resp = requests.post(f"{url.rstrip('/')}/control/drain",
+                         timeout=timeout)
+    resp.raise_for_status()
+    return resp.json()
+
+
+def undrain_replica(url: str, timeout: float = 10.0) -> dict:
+    """Re-open admission on a drained replica (rollback)."""
+    resp = requests.post(f"{url.rstrip('/')}/control/undrain",
+                         timeout=timeout)
+    resp.raise_for_status()
+    return resp.json()
+
+
 class TritonShimClient:
     """HTTP client speaking the Triton generate-extension dialect."""
 

@@ -15,11 +15,25 @@ point                   where it fires
 ``engine.harvest``      the engine's harvest worker, per harvested item
 ``http.connect``        outgoing HTTP connects (serving/client.py,
                         frontend/chat_client.py)
+``router.forward``      every fleet-router forward attempt to a replica
+                        (router/server.py, per attempt — retries re-fire)
+``replica.heartbeat``   the router's per-replica heartbeat probe
+                        (router/server.py)
 ======================  ====================================================
 
 A **fault plan** maps points to behaviors::
 
     retrieval.search=fail; engine.dispatch=delay:0.2; embed=fail*3
+
+Points that act on a *set* of peers (the router's forwards and
+heartbeats) accept an optional ``[tag]`` scope naming one peer::
+
+    router.forward[r0]=fail:conn; replica.heartbeat[r0]=fail:conn
+
+A tagged entry fires only when the call site passes a matching
+``inject(point, tag=...)``; an untagged entry fires for every tag. This
+is how a chaos test partitions ONE replica while its siblings stay
+reachable — the failure mode rolling fleets actually see.
 
 - ``fail``         raise ``FaultInjected`` at the point
 - ``fail:Exc``     raise ``Exc`` (``timeout`` → ``TimeoutError``,
@@ -52,7 +66,7 @@ from .errors import FrameworkError
 #: silently injects nothing would "pass" while testing nothing.
 POINTS = frozenset({
     "retrieval.search", "embed", "engine.dispatch", "engine.harvest",
-    "http.connect",
+    "http.connect", "router.forward", "replica.heartbeat",
 })
 
 #: Upper bound on a ``hang`` fault, seconds (env-overridable).
@@ -125,7 +139,9 @@ def _parse_one(point: str, spec: str) -> _Fault:
 
 
 def parse_plan(text: str) -> dict[str, _Fault]:
-    """``point=mode[:arg][*N]`` entries separated by ``;`` or ``,``."""
+    """``point[tag]=mode[:arg][*N]`` entries separated by ``;`` or ``,``
+    (``[tag]`` optional — scopes the fault to one peer of a multi-peer
+    point; see module docstring)."""
     plan: dict[str, _Fault] = {}
     for entry in text.replace(",", ";").split(";"):
         entry = entry.strip()
@@ -135,9 +151,14 @@ def parse_plan(text: str) -> dict[str, _Fault]:
         point = point.strip()
         if not sep or not spec.strip():
             raise FaultPlanError(f"fault plan: malformed entry {entry!r}")
-        if point not in POINTS:
+        base = point.split("[", 1)[0]
+        if "[" in point and not point.endswith("]"):
             raise FaultPlanError(
-                f"fault plan: unknown injection point {point!r} "
+                f"fault plan: malformed tag scope in {point!r} "
+                f"(use point[tag]=...)")
+        if base not in POINTS:
+            raise FaultPlanError(
+                f"fault plan: unknown injection point {base!r} "
                 f"(known: {', '.join(sorted(POINTS))})")
         plan[point] = _parse_one(point, spec.strip())
     return plan
@@ -167,12 +188,21 @@ def fired(point: str) -> int:
     return _fired.get(point, 0)
 
 
-def inject(point: str) -> None:
-    """Fire the configured fault at ``point``, if any. The production
-    cost with no plan installed is this function's first two lines."""
+def inject(point: str, tag: Optional[str] = None) -> None:
+    """Fire the configured fault at ``point``, if any. ``tag`` names the
+    specific peer at multi-peer points (a replica, a heartbeat target):
+    a ``point[tag]`` plan entry fires only on a matching tag; a bare
+    ``point`` entry fires regardless. The production cost with no plan
+    installed is this function's first two lines."""
     if not _active:
         return
-    fault = _plan.get(point)
+    fault = None
+    if tag is not None:
+        fault = _plan.get(f"{point}[{tag}]")
+        if fault is not None:
+            point = f"{point}[{tag}]"  # per-scope fired() accounting
+    if fault is None:
+        fault = _plan.get(point)
     if fault is None:
         return
     with _lock:

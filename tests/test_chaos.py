@@ -1,9 +1,19 @@
-"""Chaos smoke test (tier-1, CPU): drive a fault plan end-to-end through
-the chain server — vector store down + slow engine — and assert the stack
+"""Chaos smoke tests (tier-1, CPU): drive fault plans end-to-end.
+
+Single replica (ISSUE 5): vector store down + slow engine — the stack
 DEGRADES instead of erroring: /generate returns 200 with an LLM-only
 answer and a user-visible notice, ``degraded_total{reason="retrieval"}``
 increments, and the request's flight timeline is annotated
-``degraded=retrieval`` (ISSUE 5 acceptance criteria)."""
+``degraded=retrieval``.
+
+Fleet (ISSUE 7): a replica killed mid-stream (the client sees the
+machine-readable ``replica_lost`` error frame and the router stops
+placing there within one heartbeat) and a router↔replica partition
+(``router.forward[r0]`` + ``replica.heartbeat[r0]`` — the replica's
+breaker opens, traffic shifts to its sibling, and no request is lost)."""
+
+import asyncio
+import json
 
 import pytest
 
@@ -185,3 +195,186 @@ def test_deadline_header_through_chain_server(tmp_path):
     with eng:
         asyncio.get_event_loop_policy().new_event_loop() \
             .run_until_complete(fn())
+
+
+# ----------------------------------------------------- fleet chaos (ISSUE 7)
+
+
+def _stub_replica(kill_mid_stream: bool = False):
+    """A minimal replica app for kill scenarios: /generate streams two
+    chunks; with ``kill_mid_stream`` it hard-closes the TCP transport
+    after the first (a crashed pod, not a graceful error), and its
+    /health dies with it — the shape a real replica kill has."""
+    from aiohttp import web
+
+    state = {"dead": False}
+
+    async def generate(request):
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream",
+            "X-Request-ID": request.headers.get("X-Request-ID", "stub")})
+        await resp.prepare(request)
+        await resp.write(b"partial answer ")
+        if kill_mid_stream:
+            await asyncio.sleep(0.05)  # let the first chunk flush
+            state["dead"] = True
+            request.transport.close()  # SIGKILL, as seen from the wire
+            return resp
+        await resp.write(b"complete")
+        await resp.write_eof()
+        return resp
+
+    async def health(request):
+        if state["dead"]:
+            request.transport.close()
+            return web.Response()
+        return web.json_response({
+            "status": "ok", "draining": False, "breaker": "closed",
+            "load": {"in_flight": 0, "queue_depth": 0,
+                     "rejected_total": 0}})
+
+    app = web.Application()
+    app.router.add_post("/generate", generate)
+    app.router.add_get("/health", health)
+    return app
+
+
+@pytest.mark.chaos
+def test_chaos_replica_kill_mid_stream_error_frame_and_failover():
+    """Replica dies mid-stream: the caller's 200 degrades with the
+    machine-readable ``replica_lost`` frame (not a hang, not silent
+    truncation), the router stops placing on the corpse within one
+    heartbeat, and a runtime-added healthy replica restores service."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from generativeaiexamples_tpu.frontend.chat_client import (
+        ERROR_EVENT_MARK)
+    from generativeaiexamples_tpu.router.server import create_router_app
+
+    async def fn():
+        dying = TestServer(_stub_replica(kill_mid_stream=True))
+        healthy = TestServer(_stub_replica())
+        await dying.start_server()
+        await healthy.start_server()
+        router_app = create_router_app(
+            [("r0", f"http://127.0.0.1:{dying.port}")],
+            policy="affinity", heartbeat_s=30, run_heartbeat=False)
+        client = TestClient(TestServer(router_app))
+        await client.start_server()
+        try:
+            resp = await client.post(
+                "/generate", json={"question": "q"},
+                headers={"X-Request-ID": "kill-1"})
+            assert resp.status == 200
+            assert resp.headers["X-Routed-Replica"] == "r0"
+            body = (await resp.read()).decode()
+            # partial output stands; the failure is machine-readable
+            assert body.startswith("partial answer ")
+            assert "[error]" in body and ERROR_EVENT_MARK in body
+            frame = json.loads(
+                body.split(ERROR_EVENT_MARK, 1)[1].strip().split("\n")[0])
+            assert frame["error"] == "replica_lost"
+            assert frame["replica"] == "r0"
+            assert frame["request_id"] == "kill-1"
+            # placement stopped IMMEDIATELY (mid-stream loss marks the
+            # replica unreachable without waiting for the heartbeat) ...
+            snap = await (await client.get("/router/replicas")).json()
+            r0 = next(r for r in snap["replicas"] if r["name"] == "r0")
+            assert not r0["placeable"] and not r0["reachable"]
+            # ... and the next heartbeat agrees (the probe hits the dead
+            # transport), so the exclusion survives the next cycle too.
+            await client.post("/control/heartbeat")
+            snap = await (await client.get("/router/replicas")).json()
+            r0 = next(r for r in snap["replicas"] if r["name"] == "r0")
+            assert not r0["placeable"]
+            # with the only replica dead: typed 503, NOT a hang
+            resp = await client.post("/generate", json={"question": "q"})
+            assert resp.status == 503
+            assert (await resp.json())["error"]["type"] == "no_replicas"
+            # rollouts recover at runtime: add a healthy replica
+            resp = await client.post("/control/replicas", json={
+                "op": "add", "name": "r1",
+                "url": f"http://127.0.0.1:{healthy.port}"})
+            assert resp.status == 200
+            resp = await client.post("/generate", json={"question": "q"})
+            assert resp.status == 200
+            assert resp.headers["X-Routed-Replica"] == "r1"
+            assert (await resp.read()).decode().endswith("complete")
+        finally:
+            await client.close()
+            await dying.close()
+            await healthy.close()
+
+    asyncio.get_event_loop_policy().new_event_loop() \
+        .run_until_complete(fn())
+
+
+@pytest.mark.chaos
+def test_chaos_router_replica_partition_breaker_opens_traffic_shifts():
+    """Partition ONE replica from the router (forwards AND heartbeats
+    fail at connect for r0 only): every caller request still succeeds on
+    the sibling (no request lost, none run twice — connect-phase
+    failures are the only retried kind), r0's breaker opens after the
+    configured consecutive failures, and the heartbeat confirms the
+    partition."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from generativeaiexamples_tpu.chains.server import create_app
+    from generativeaiexamples_tpu.router.server import create_router_app
+    from tests.test_router import EchoExample, _snapshot
+
+    faults.set_plan("router.forward[r0]=fail:conn; "
+                    "replica.heartbeat[r0]=fail:conn")
+
+    async def fn():
+        servers = [TestServer(create_app(EchoExample())) for _ in range(2)]
+        for s in servers:
+            await s.start_server()
+        router_app = create_router_app(
+            [(f"r{i}", f"http://127.0.0.1:{s.port}")
+             for i, s in enumerate(servers)],
+            policy="affinity", heartbeat_s=30, run_heartbeat=False)
+        client = TestClient(TestServer(router_app))
+        await client.start_server()
+        try:
+            retries0 = _snapshot('router_retries_total{reason="connect"}')
+            statuses = []
+            for i in range(6):
+                resp = await client.post(
+                    "/generate", json={"question": f"q{i}",
+                                       "use_knowledge_base": False})
+                statuses.append(resp.status)
+                if resp.status == 200:
+                    assert resp.headers["X-Routed-Replica"] == "r1"
+                    assert (await resp.read()).decode() == f"echo:q{i}"
+            # no request lost: the partition is invisible to callers
+            assert statuses == [200] * 6
+            assert faults.fired("router.forward[r0]") >= 3
+            assert _snapshot('router_retries_total{reason="connect"}') \
+                - retries0 == faults.fired("router.forward[r0]")
+            snap = await (await client.get("/router/replicas")).json()
+            r0 = next(r for r in snap["replicas"] if r["name"] == "r0")
+            # breaker opened after ROUTER_BREAKER_FAILURES consecutive
+            # connect failures -> placement stops even without heartbeat
+            assert r0["breaker"] == "open" and not r0["placeable"]
+            # the heartbeat sees the same partition
+            await client.post("/control/heartbeat")
+            assert faults.fired("replica.heartbeat[r0]") >= 1
+            snap = await (await client.get("/router/replicas")).json()
+            r0 = next(r for r in snap["replicas"] if r["name"] == "r0")
+            assert not r0["reachable"]
+            r1 = next(r for r in snap["replicas"] if r["name"] == "r1")
+            assert r1["placeable"] and r1["placements"] == 6
+            # partition heals: plan cleared, heartbeat restores r0
+            faults.clear()
+            await client.post("/control/heartbeat")
+            snap = await (await client.get("/router/replicas")).json()
+            r0 = next(r for r in snap["replicas"] if r["name"] == "r0")
+            assert r0["reachable"]  # breaker still cooling down is fine
+        finally:
+            await client.close()
+            for s in servers:
+                await s.close()
+
+    asyncio.get_event_loop_policy().new_event_loop() \
+        .run_until_complete(fn())

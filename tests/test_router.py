@@ -1,0 +1,897 @@
+"""Fleet router tests (tier-1, CPU).
+
+Unit: affinity hashing/sketch/scoring, drain and health exclusion,
+retry-budget accounting, replica-table race safety, fault-plan tag
+scoping. Chain server: readiness truthfulness (drain + breaker
+transitions). Acceptance (ISSUE 7): two in-process engine replicas
+behind the router — a multi-turn chat session with a shared system
+prompt sticks to one replica, its warm-turn TTFT beats a forced
+round-robin placement (prefix pages actually reused), and killing that
+replica mid-stream fails over within one heartbeat with a real error
+frame, not a hang.
+"""
+
+import asyncio
+import json
+import statistics
+import threading
+import time
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import aiohttp  # noqa: F401 — skip cleanly where aiohttp is absent
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from generativeaiexamples_tpu.chains.base import BaseExample
+from generativeaiexamples_tpu.chains.server import (DRAIN_STATE,
+                                                    GENERATE_BREAKER,
+                                                    create_app)
+from generativeaiexamples_tpu.router import metrics as router_metrics
+from generativeaiexamples_tpu.router.server import create_router_app
+from generativeaiexamples_tpu.router.table import (ReplicaTable,
+                                                   affinity_blocks)
+from generativeaiexamples_tpu.obs import metrics as obs_metrics
+from generativeaiexamples_tpu.utils import faults, resilience
+
+pytestmark = []
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.clear()
+    resilience.reset_breakers()
+    yield
+    faults.clear()
+    resilience.reset_breakers()
+
+
+def _run(coro):
+    loop = asyncio.get_event_loop_policy().new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def _snapshot(name: str) -> float:
+    return obs_metrics.REGISTRY.snapshot().get(name, 0.0)
+
+
+class EchoExample(BaseExample):
+    """Minimal real chain-server example: streams a deterministic echo."""
+
+    def llm_chain(self, context, question, num_tokens):
+        yield f"echo:{question[:32]}"
+
+    def rag_chain(self, prompt, num_tokens):
+        yield f"rag:{prompt[:32]}"
+
+    def ingest_docs(self, data_dir, filename):
+        pass
+
+
+# --------------------------------------------------------------- affinity
+
+
+def test_affinity_blocks_chained_prefix_semantics():
+    a = affinity_blocks("s" * 300, block_bytes=64)
+    b = affinity_blocks("s" * 300, block_bytes=64)
+    assert a and a == b  # deterministic
+    # Shared 128-byte head -> identical first 2 blocks, then divergence.
+    c = affinity_blocks("s" * 128 + "t" * 172, block_bytes=64)
+    assert c[:2] == a[:2] and c[2:] != a[2:4]
+    # head cap bounds the block count
+    assert len(affinity_blocks("x" * 10_000, block_bytes=64,
+                               head_bytes=256)) == 4
+
+
+def test_affinity_scoring_beats_load_only_on_shared_prefix():
+    """Two sessions, two replicas: the affinity policy keeps each
+    session pinned to the replica that served it even when a load blip
+    would tempt a load-only scorer away; with affinity_weight=0 the
+    same blip bounces the session (and would cost a cold prefill)."""
+    def sticky_fraction(affinity_weight: float) -> float:
+        table = ReplicaTable(affinity_weight=affinity_weight)
+        table.add("r0", "http://a")
+        table.add("r1", "http://b")
+        sessions = {s: affinity_blocks(f"system prompt {s} " + "x" * 400)
+                    for s in ("A", "B")}
+        homes = {}
+        for s, blocks in sessions.items():
+            rep = table.place(blocks)
+            table.record_placement(rep, blocks)
+            homes[s] = rep.name
+        assert homes["A"] != homes["B"]  # tie-break spread them out
+        sticky = 0
+        for s, blocks in sessions.items():
+            # A load blip on THIS session's home (its sibling is idle):
+            # the moment a load-only scorer would bounce — and cold-miss.
+            for name in ("r0", "r1"):
+                table.update_health(name, ok=True, body={
+                    "load": {"queue_depth": 1 if name == homes[s] else 0}})
+            rep = table.place(blocks)
+            table.record_placement(rep, blocks)
+            sticky += rep.name == homes[s]
+        return sticky / len(sessions)
+
+    assert sticky_fraction(affinity_weight=2.0) == 1.0
+    assert sticky_fraction(affinity_weight=0.0) == 0.0
+
+
+def test_sketch_is_bounded_lru():
+    table = ReplicaTable(sketch_cap=8)
+    rep = table.add("r0", "http://a")
+    for i in range(10):
+        table.record_placement(rep, affinity_blocks(f"{i:03d}" * 100))
+    assert len(rep.sketch) <= 8
+    # the most recent prompt's blocks survived
+    last = affinity_blocks("009" * 100)
+    assert table._match(rep, last) > 0
+
+
+def test_draining_replica_receives_zero_placements():
+    table = ReplicaTable()
+    table.add("r0", "http://a")
+    table.add("r1", "http://b")
+    table.mark_draining("r0")
+    for i in range(8):
+        rep = table.place(affinity_blocks(f"p{i}" * 50))
+        assert rep.name == "r1"
+        table.record_placement(rep, ())
+    table.mark_draining("r0", False)
+    names = {table.place((), exclude=("r1",)).name}
+    assert names == {"r0"}  # placeable again after undrain
+
+
+def test_unreachable_unready_and_breaker_open_are_excluded():
+    table = ReplicaTable(breaker_failures=2)
+    r0 = table.add("r0", "http://a")
+    table.add("r1", "http://b")
+    table.update_health("r0", ok=False, ready=False)
+    assert table.place(()).name == "r1"
+    table.update_health("r0", ok=True, ready=False)  # 503: drain/breaker
+    assert table.place(()).name == "r1"
+    table.update_health("r0", ok=True, ready=True)
+    r0.breaker.record_failure()
+    r0.breaker.record_failure()  # threshold 2 -> OPEN
+    assert r0.breaker.state == resilience.OPEN
+    assert all(table.place(()).name == "r1" for _ in range(4))
+    # no placeable replica at all -> None (the router's 503 no_replicas)
+    table.mark_draining("r1")
+    assert table.place(()) is None
+
+
+def test_replica_table_add_remove_races_are_safe():
+    """Placement keeps working while replicas churn from other threads —
+    no exceptions, and every returned replica is a real table member of
+    the moment (or a just-removed one, which the forward path handles
+    via its breaker; what matters here is no corruption)."""
+    table = ReplicaTable()
+    table.add("stable", "http://s")
+    stop = threading.Event()
+    errors: list = []
+
+    def churn(i: int):
+        try:
+            while not stop.is_set():
+                table.add(f"r{i}", f"http://{i}")
+                table.update_health(f"r{i}", ok=True,
+                                    body={"load": {"queue_depth": i}})
+                table.remove(f"r{i}")
+        except Exception as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    threads = [threading.Thread(target=churn, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        blocks = affinity_blocks("shared prefix " * 40)
+        for _ in range(300):
+            rep = table.place(blocks)
+            assert rep is not None
+            table.record_placement(rep, blocks)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+    assert not errors
+    assert table.get("stable") is not None
+    snap = table.snapshot()
+    assert any(r["name"] == "stable" for r in snap)
+
+
+def test_round_robin_policy_ignores_affinity():
+    table = ReplicaTable(policy="round_robin")
+    table.add("r0", "http://a")
+    table.add("r1", "http://b")
+    blocks = affinity_blocks("same prefix " * 40)
+    seen = []
+    for _ in range(4):
+        rep = table.place(blocks)
+        table.record_placement(rep, blocks)
+        seen.append(rep.name)
+    assert seen == ["r0", "r1", "r0", "r1"]
+
+
+# ------------------------------------------------------- fault tag scoping
+
+
+def test_fault_plan_tag_scoping():
+    plan = faults.parse_plan("router.forward[r0]=fail:conn; "
+                             "replica.heartbeat=delay:0")
+    assert set(plan) == {"router.forward[r0]", "replica.heartbeat"}
+    with pytest.raises(faults.FaultPlanError):
+        faults.parse_plan("router.forward[r0=fail")  # malformed tag
+    with pytest.raises(faults.FaultPlanError):
+        faults.parse_plan("not.a.point[r0]=fail")
+
+    faults.set_plan("router.forward[r0]=fail:conn")
+    faults.inject("router.forward", tag="r1")   # other tag: no fire
+    faults.inject("router.forward")             # untagged call: no fire
+    with pytest.raises(ConnectionError):
+        faults.inject("router.forward", tag="r0")
+    assert faults.fired("router.forward[r0]") == 1
+    assert faults.fired("router.forward") == 0
+
+    faults.set_plan("router.forward=fail:conn")  # untagged: every tag
+    with pytest.raises(ConnectionError):
+        faults.inject("router.forward", tag="anything")
+    with pytest.raises(ConnectionError):
+        faults.inject("router.forward")
+
+
+# --------------------------------------------- readiness truthfulness (s2)
+
+
+def test_health_truthful_across_drain_transitions():
+    app = create_app(EchoExample())
+
+    async def fn():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            resp = await client.get("/health")
+            assert resp.status == 200
+            body = await resp.json()
+            assert body["status"] == "ok" and body["draining"] is False
+            assert "in_flight" in body["load"]
+
+            resp = await client.post("/control/drain")
+            assert resp.status == 200
+            # not ready while draining — k8s and the router both see it
+            resp = await client.get("/health")
+            assert resp.status == 503
+            body = await resp.json()
+            assert body["status"] == "draining" and body["draining"]
+            # and every work endpoint sheds with the draining contract
+            for path, payload in (
+                    ("/generate", {"question": "q"}),
+                    ("/documentSearch", {"content": "c"})):
+                resp = await client.post(path, json=payload)
+                assert resp.status == 429
+                err = await resp.json()
+                assert err["error"]["type"] == "draining"
+                assert "Retry-After" in resp.headers
+
+            resp = await client.post("/control/undrain")
+            assert resp.status == 200
+            resp = await client.get("/health")
+            assert resp.status == 200
+            assert (await resp.json())["status"] == "ok"
+            resp = await client.post("/generate", json={"question": "hi"})
+            assert resp.status == 200  # admission re-opened
+        finally:
+            await client.close()
+
+    _run(fn())
+
+
+def test_health_truthful_across_breaker_transitions():
+    app = create_app(EchoExample())
+    breaker = app[GENERATE_BREAKER]
+
+    async def fn():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            assert (await client.get("/health")).status == 200
+            for _ in range(breaker.failure_threshold):
+                breaker.record_failure()
+            assert breaker.state == resilience.OPEN
+            resp = await client.get("/health")
+            assert resp.status == 503
+            assert (await resp.json())["status"] == "breaker_open"
+            breaker.record_success()  # probe succeeded -> closed
+            resp = await client.get("/health")
+            assert resp.status == 200
+            assert (await resp.json())["status"] == "ok"
+        finally:
+            await client.close()
+
+    _run(fn())
+
+
+def test_drain_counts_in_flight_streams():
+    """The drain body/health expose the live in-flight count, and the
+    counter returns to 0 when the stream finishes (what the preStop
+    drain CLI polls)."""
+    release = threading.Event()
+
+    class SlowExample(BaseExample):
+        def llm_chain(self, context, question, num_tokens):
+            yield "first"
+            release.wait(timeout=30)
+            yield "second"
+
+        def rag_chain(self, prompt, num_tokens):
+            yield from self.llm_chain("", prompt, num_tokens)
+
+        def ingest_docs(self, data_dir, filename):
+            pass
+
+    app = create_app(SlowExample())
+    drain_state = app[DRAIN_STATE]
+
+    async def fn():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            resp = await client.post("/generate", json={
+                "question": "q", "use_knowledge_base": False})
+            assert resp.status == 200  # first chunk arrived; stream open
+            body = await (await client.post("/control/drain")).json()
+            assert body["in_flight"] == 1
+            # new work refused while the stream runs on
+            assert (await client.post("/generate",
+                                      json={"question": "x"})).status == 429
+            release.set()
+            assert (await resp.read()).decode().endswith("second")
+            deadline = time.monotonic() + 10
+            while drain_state.in_flight and time.monotonic() < deadline:
+                await asyncio.sleep(0.01)
+            assert drain_state.in_flight == 0
+        finally:
+            await client.close()
+
+    _run(fn())
+
+
+# ----------------------------------------------------- router HTTP surface
+
+
+def test_router_forwards_generate_and_relays_identity():
+    app = create_app(EchoExample())
+
+    async def fn():
+        replica = TestServer(app)
+        await replica.start_server()
+        url = f"http://127.0.0.1:{replica.port}"
+        router_app = create_router_app([("r0", url)], policy="affinity",
+                                       heartbeat_s=30, run_heartbeat=False)
+        client = TestClient(TestServer(router_app))
+        await client.start_server()
+        try:
+            resp = await client.post(
+                "/generate",
+                json={"question": "hello", "use_knowledge_base": False},
+                headers={"X-Request-ID": "fwd-1"})
+            assert resp.status == 200
+            assert resp.headers["X-Routed-Replica"] == "r0"
+            assert resp.headers["X-Request-ID"] == "fwd-1"
+            assert (await resp.read()).decode() == "echo:hello"
+            # non-2xx relays verbatim (422 from the replica's validation)
+            resp = await client.post("/generate", json={})
+            assert resp.status == 422
+        finally:
+            await client.close()
+            await replica.close()
+
+    _run(fn())
+
+
+def test_router_draining_replica_zero_new_placements_e2e():
+    apps = [create_app(EchoExample()), create_app(EchoExample())]
+
+    async def fn():
+        servers = [TestServer(a) for a in apps]
+        for s in servers:
+            await s.start_server()
+        urls = [f"http://127.0.0.1:{s.port}" for s in servers]
+        router_app = create_router_app(
+            [(f"r{i}", u) for i, u in enumerate(urls)],
+            policy="affinity", heartbeat_s=30, run_heartbeat=False)
+        client = TestClient(TestServer(router_app))
+        await client.start_server()
+        try:
+            # Establish affinity: the session's first turn lands
+            # somewhere; note WHICH replica, then drain exactly it.
+            session = {"question": "turn", "context": "system " * 60,
+                       "use_knowledge_base": False}
+            resp = await client.post("/generate", json=session)
+            assert resp.status == 200
+            home = resp.headers["X-Routed-Replica"]
+            other = "r1" if home == "r0" else "r0"
+            home_url = urls[int(home[1])]
+            before_retry = _snapshot(
+                'router_retries_total{reason="draining"}')
+            async with aiohttp.ClientSession() as s:
+                async with s.post(home_url + "/control/drain") as resp:
+                    assert resp.status == 200
+            # BEFORE any heartbeat the router still prefers the home
+            # (affinity); the home 429s as draining and the router
+            # transparently retries on the sibling — the caller sees a
+            # 200 (nothing lost in the race window).
+            resp = await client.post("/generate", json=session)
+            assert resp.status == 200
+            assert resp.headers["X-Routed-Replica"] == other
+            assert _snapshot('router_retries_total{reason="draining"}') \
+                >= before_retry + 1
+            # After the heartbeat the router knows; the draining replica
+            # gets ZERO placements.
+            await client.post("/control/heartbeat")
+            placed_home = _snapshot(
+                f'router_placed_total{{replica="{home}"}}')
+            for i in range(6):
+                resp = await client.post("/generate", json=session)
+                assert resp.status == 200
+                assert resp.headers["X-Routed-Replica"] == other
+            assert _snapshot(
+                f'router_placed_total{{replica="{home}"}}') == placed_home
+            # Undrain + heartbeat: placeable again (rollback path).
+            async with aiohttp.ClientSession() as s:
+                async with s.post(home_url + "/control/undrain") as resp:
+                    assert resp.status == 200
+            await client.post("/control/heartbeat")
+            snap = await (await client.get("/router/replicas")).json()
+            rhome = next(r for r in snap["replicas"] if r["name"] == home)
+            assert rhome["placeable"]
+        finally:
+            await client.close()
+            for s in servers:
+                await s.close()
+
+    _run(fn())
+
+
+def test_router_connect_retry_budget_and_no_replicas():
+    app = create_app(EchoExample())
+
+    async def fn():
+        replica = TestServer(app)
+        await replica.start_server()
+        url = f"http://127.0.0.1:{replica.port}"
+        router_app = create_router_app(
+            [("r0", url), ("r1", url)], policy="affinity",
+            heartbeat_s=30, retry_attempts=2, run_heartbeat=False)
+        client = TestClient(TestServer(router_app))
+        await client.start_server()
+        try:
+            # Both replicas partitioned at connect: the budget (2) is
+            # spent and the caller gets a typed 502, not a hang.
+            faults.set_plan("router.forward=fail:conn")
+            before = _snapshot('router_retries_total{reason="connect"}')
+            resp = await client.post(
+                "/generate", json={"question": "q",
+                                   "use_knowledge_base": False})
+            assert resp.status == 502
+            body = await resp.json()
+            assert body["error"]["type"] == "replica_error"
+            assert _snapshot('router_retries_total{reason="connect"}') \
+                == before + 2  # budget honored: exactly 2 attempts
+            # One replica partitioned: retry lands on the other, caller
+            # sees success (single-failure transparency).
+            faults.set_plan("router.forward[r0]=fail:conn")
+            resp = await client.post(
+                "/generate", json={"question": "q2",
+                                   "use_knowledge_base": False})
+            assert resp.status == 200
+            assert resp.headers["X-Routed-Replica"] == "r1"
+            faults.clear()
+            # Every replica excluded (drained) -> 503 no_replicas.
+            async with aiohttp.ClientSession() as s:
+                for u in {url}:
+                    async with s.post(u + "/control/drain"):
+                        pass
+            await client.post("/control/heartbeat")
+            resp = await client.post(
+                "/generate", json={"question": "q3",
+                                   "use_knowledge_base": False})
+            assert resp.status == 503
+            assert (await resp.json())["error"]["type"] == "no_replicas"
+            assert "Retry-After" in resp.headers
+        finally:
+            await client.close()
+            await replica.close()
+
+    _run(fn())
+
+
+def test_router_all_replicas_draining_relays_429_not_502():
+    """A rollout must look like backpressure to callers: when every
+    placeable replica answers 429 draining (single-replica fleets hit
+    this on every rollout), the router relays the 429 + Retry-After
+    instead of inventing a 502."""
+    app = create_app(EchoExample())
+
+    async def fn():
+        replica = TestServer(app)
+        await replica.start_server()
+        url = f"http://127.0.0.1:{replica.port}"
+        router_app = create_router_app(
+            [("r0", url)], policy="affinity", heartbeat_s=30,
+            run_heartbeat=False)
+        client = TestClient(TestServer(router_app))
+        await client.start_server()
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.post(url + "/control/drain") as resp:
+                    assert resp.status == 200
+            # No heartbeat has run: the router still thinks r0 is
+            # placeable, forwards, and gets the draining refusal with
+            # nobody else to hand it to.
+            resp = await client.post(
+                "/generate", json={"question": "q",
+                                   "use_knowledge_base": False})
+            assert resp.status == 429
+            body = await resp.json()
+            assert body["error"]["type"] == "draining"
+            assert "Retry-After" in resp.headers
+        finally:
+            await client.close()
+            await replica.close()
+
+    _run(fn())
+
+
+class _SlowEchoExample(BaseExample):
+    """Streams many small chunks so a caller can hang up mid-stream."""
+
+    def llm_chain(self, context, question, num_tokens):
+        for i in range(60):
+            yield f"tok{i} "
+            time.sleep(0.04)
+
+    def rag_chain(self, prompt, num_tokens):
+        yield "rag"
+
+    def ingest_docs(self, data_dir, filename):
+        pass
+
+
+def test_caller_disconnect_does_not_penalize_replica():
+    """A client hanging up mid-stream is the CALLER's doing — it must
+    not feed the replica's breaker or mark it unreachable (three
+    impatient clients would otherwise open the breaker and 503 a
+    perfectly healthy single-replica fleet)."""
+    app = create_app(_SlowEchoExample())
+
+    async def fn():
+        replica = TestServer(app)
+        await replica.start_server()
+        url = f"http://127.0.0.1:{replica.port}"
+        router_app = create_router_app(
+            [("r0", url)], policy="affinity", heartbeat_s=30,
+            run_heartbeat=False)
+        from generativeaiexamples_tpu.router.server import ROUTER
+        router = router_app[ROUTER]
+        client = TestClient(TestServer(router_app))
+        await client.start_server()
+        try:
+            for _ in range(3):  # would trip the breaker if misfiled
+                resp = await client.post(
+                    "/generate", json={"question": "slow",
+                                       "use_knowledge_base": False,
+                                       "num_tokens": 8})
+                assert resp.status == 200
+                await resp.content.read(4)   # stream has begun
+                resp.close()                 # caller hangs up
+                await asyncio.sleep(0.3)     # router hits the dead pipe
+            rep = router.table.get("r0")
+            assert rep.breaker.state == resilience.CLOSED
+            assert rep.placeable()
+            # ... and the replica still serves the next caller fully.
+            resp = await client.post(
+                "/generate", json={"question": "after",
+                                   "use_knowledge_base": False,
+                                   "num_tokens": 8})
+            assert resp.status == 200
+            body = (await resp.read()).decode()
+            assert "tok59" in body and "[error]" not in body
+        finally:
+            await client.close()
+            await replica.close()
+
+    _run(fn())
+
+
+def test_parse_replicas_names_and_duplicate_rejection():
+    from generativeaiexamples_tpu.router.__main__ import parse_replicas
+
+    assert parse_replicas("r0=http://a:1, r1=http://b:2") \
+        == [("r0", "http://a:1"), ("r1", "http://b:2")]
+    assert parse_replicas("http://a:1,http://b:2") \
+        == [("r0", "http://a:1"), ("r1", "http://b:2")]
+    with pytest.raises(ValueError, match="duplicate"):
+        parse_replicas("r0=http://a:1,r0=http://b:2")
+    with pytest.raises(ValueError, match="duplicate"):
+        # bare URL at position 1 auto-names to r1, colliding with the
+        # explicit r1 — must be loud, not last-writer-wins
+        parse_replicas("r1=http://a:1,http://b:2")
+
+
+def test_recent_rejects_first_heartbeat_is_baseline():
+    """A replica's lifetime rejected_total must not count as 'recent'
+    shed on the router's FIRST observation of it (router restart /
+    re-add) — only between-heartbeat diffs are load signal."""
+    table = ReplicaTable()
+    table.add("r0", "http://a")
+    table.update_health(
+        "r0", ok=True, body={"load": {"rejected_total": 10_000}})
+    assert table.get("r0").recent_rejects == 0.0
+    table.update_health(
+        "r0", ok=True, body={"load": {"rejected_total": 10_007}})
+    assert table.get("r0").recent_rejects == 7.0
+    # re-add resets the baseline too
+    table.add("r0", "http://a")
+    table.update_health(
+        "r0", ok=True, body={"load": {"rejected_total": 10_007}})
+    assert table.get("r0").recent_rejects == 0.0
+
+
+# ------------------------------------------------- acceptance (two engines)
+
+
+class _LiveServer:
+    """A replica app on its own thread+loop, killable mid-stream: stop()
+    force-closes in-flight connections after a 0.2 s grace — the wire
+    shape of a pod being killed, which aiohttp's in-loop TestServer
+    cannot produce."""
+
+    def __init__(self, app):
+        self._app = app
+        self._loop = asyncio.new_event_loop()
+        self._started = threading.Event()
+        self._runner = None
+        self.port = None
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        asyncio.set_event_loop(self._loop)
+
+        async def boot():
+            self._runner = web.AppRunner(self._app)
+            await self._runner.setup()
+            site = web.TCPSite(self._runner, "127.0.0.1", 0,
+                               shutdown_timeout=0.2)
+            await site.start()
+            self.port = self._runner.addresses[0][1]
+        self._loop.run_until_complete(boot())
+        self._started.set()
+        self._loop.run_forever()
+
+    def start(self) -> str:
+        self._thread.start()
+        assert self._started.wait(30), "replica server failed to boot"
+        return f"http://127.0.0.1:{self.port}"
+
+    def kill(self):
+        fut = asyncio.run_coroutine_threadsafe(self._runner.cleanup(),
+                                               self._loop)
+        try:
+            fut.result(timeout=30)
+        finally:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            self._thread.join(timeout=10)
+
+
+def _convo_words(tag: str, n_chars: int) -> str:
+    import hashlib
+    h = hashlib.blake2b(tag.encode(), digest_size=32).hexdigest()
+    return (h * (n_chars // len(h) + 1))[:n_chars]
+
+
+@pytest.fixture(scope="module")
+def fleet_engines():
+    from generativeaiexamples_tpu.engine import Engine, EngineConfig
+    from generativeaiexamples_tpu.models import llama
+    from generativeaiexamples_tpu.models.configs import LlamaConfig
+    from generativeaiexamples_tpu.models.tokenizer import ByteTokenizer
+
+    cfg = LlamaConfig(vocab_size=259 + 5, hidden_size=64,
+                      intermediate_size=128, num_layers=2, num_heads=4,
+                      num_kv_heads=2, head_dim=16,
+                      max_position_embeddings=2048)
+    params = llama.init_params(cfg, jax.random.key(21), dtype=jnp.float32)
+    # ONE prefill bucket: every chunk is the same 64-token program, so
+    # the warmup convo's sweep compiles the full (chunk x KV-window)
+    # matrix the measured turns will use — with a bucket ladder, a warm
+    # turn could hit an uncompiled combo and its ~1.5 s CPU compile
+    # would drown the prefix-reuse TTFT signal this test reads.
+    ecfg = EngineConfig(
+        max_slots=2, max_input_length=2048, max_output_length=64,
+        prefill_buckets=(64,), max_prefill_bucket=64,
+        dtype="float32", page_size=16, kv_pool_tokens=4096, max_queue=16,
+        steps_per_round=4)
+    # Two replicas over SHARED params — weights are read-only; each gets
+    # its own KV pool and prefix cache (that separation is the point).
+    engines = [Engine(params, cfg, ByteTokenizer(), ecfg)
+               for _ in range(2)]
+    for e in engines:
+        e.start()
+    yield engines
+    for e in engines:
+        e.stop()
+
+
+def _fleet_apps(engines):
+    from generativeaiexamples_tpu.chains.examples.developer_rag import (
+        QAChatbot)
+    from generativeaiexamples_tpu.chains.llm import EngineLLM
+    from generativeaiexamples_tpu.embed.encoder import HashEmbedder
+    from generativeaiexamples_tpu.utils.app_config import AppConfig
+    from generativeaiexamples_tpu.utils.configuration import from_dict
+
+    cfg = from_dict(AppConfig, {
+        "llm": {"model_engine": "tpu-jax"},
+        "embeddings": {"model_engine": "hash", "dimensions": 32},
+    })
+    return [create_app(QAChatbot(llm=EngineLLM(e),
+                                 embedder=HashEmbedder(dim=32),
+                                 config=cfg, fused_rag=False), config=cfg)
+            for e in engines]
+
+
+def test_acceptance_affinity_fleet_warm_ttft_and_failover(fleet_engines):
+    """ISSUE 7 acceptance: two in-process engine replicas behind the
+    router. A multi-turn chat session with a shared system prompt lands
+    on the SAME replica and its warm-turn TTFT beats a forced
+    round-robin placement (the engines' prefix-hit counters prove the
+    pages were actually reused, not that the delta is noise); killing
+    that replica mid-stream fails over within one heartbeat with a real
+    error frame, not a hang."""
+    engines = fleet_engines
+    servers = [_LiveServer(app) for app in _fleet_apps(engines)]
+    urls = [s.start() for s in servers]
+    killed = [False, False]
+
+    async def convo(post, turns, tag, *, system_chars=600, user_chars=40,
+                    num_tokens=8, collect=None):
+        """One chat session: shared system prompt + growing history."""
+        system = _convo_words(f"sys-{tag}", system_chars)
+        history = ""
+        for t in range(turns):
+            question = _convo_words(f"{tag}-t{t}", user_chars)
+            t0 = time.monotonic()
+            resp = await post({"question": question,
+                               "context": system + history,
+                               "use_knowledge_base": False,
+                               "num_tokens": num_tokens})
+            ttft_ms = (time.monotonic() - t0) * 1e3
+            assert resp.status == 200
+            answer = (await resp.read()).decode("utf-8", errors="replace")
+            if collect is not None:
+                collect.append({
+                    "turn": t, "ttft_ms": ttft_ms,
+                    "replica": resp.headers.get("X-Routed-Replica", "")})
+            history += f"\nUser: {question}\nAssistant: {answer}"
+        return history
+
+    async def fn():
+        # Warm every compile geometry on BOTH replicas first: prompt
+        # lengths sweep PAST anything the measured convos reach (chunk
+        # buckets 64/256/1024 and every KV-window rung up to ~1500
+        # tokens), so neither policy's measured turns pay a one-time XLA
+        # compile — on CPU a single compile (~1.5 s) would drown the
+        # prefix-reuse signal this test exists to read.
+        async with aiohttp.ClientSession() as s:
+            for i, url in enumerate(urls):
+                hist = ""
+                sysw = _convo_words(f"warm-sys-{i}", 700)
+                for t, ulen in enumerate((40, 150, 260, 40)):
+                    q = _convo_words(f"warm-{i}-t{t}", ulen)
+                    async with s.post(f"{url}/generate", json={
+                            "question": q, "context": sysw + hist,
+                            "use_knowledge_base": False,
+                            "num_tokens": 8}) as resp:
+                        assert resp.status == 200, await resp.text()
+                        ans = (await resp.read()).decode(
+                            "utf-8", errors="replace")
+                    hist += f"\nUser: {q}\nAssistant: {ans}"
+                    hist += _convo_words(f"warm-pad-{i}-{t}", 120)
+
+        def hits():
+            return [int(e.stats.get("prefix_cache_hit_tokens", 0))
+                    for e in engines]
+
+        # ---- affinity session: sticks to one replica, reuses pages
+        router_app = create_router_app(
+            [(f"r{i}", u) for i, u in enumerate(urls)],
+            policy="affinity", heartbeat_s=0.3, run_heartbeat=True)
+        client = TestClient(TestServer(router_app))
+        await client.start_server()
+        rows_aff: list = []
+        hits0 = hits()
+        await convo(lambda j: client.post("/generate", json=j),
+                    turns=4, tag="aff", collect=rows_aff)
+        placed = {r["replica"] for r in rows_aff}
+        assert len(placed) == 1, f"session bounced: {rows_aff}"
+        home = placed.pop()
+        home_i = int(home[1])
+        aff_hits = sum(hits()) - sum(hits0)
+        assert aff_hits > 0  # prefix pages actually reused
+        warm_aff = [r["ttft_ms"] for r in rows_aff if r["turn"] > 0]
+
+        # ---- forced round-robin baseline: bounces, re-prefills cold
+        rr_app = create_router_app(
+            [(f"r{i}", u) for i, u in enumerate(urls)],
+            policy="round_robin", heartbeat_s=0.3, run_heartbeat=True)
+        rr_client = TestClient(TestServer(rr_app))
+        await rr_client.start_server()
+        rows_rr: list = []
+        hits1 = hits()
+        await convo(lambda j: rr_client.post("/generate", json=j),
+                    turns=4, tag="rr", collect=rows_rr)
+        rr_hits = sum(hits()) - sum(hits1)
+        assert len({r["replica"] for r in rows_rr}) == 2  # it really RRs
+        warm_rr = [r["ttft_ms"] for r in rows_rr if r["turn"] > 0]
+        await rr_client.close()
+
+        # Warm-turn TTFT: affinity beats the round-robin placement, and
+        # the hit counters show WHY (more prefix tokens served from
+        # cache; RR's hop to a cold sibling re-prefills the history).
+        assert statistics.mean(warm_aff) < statistics.mean(warm_rr), \
+            (warm_aff, warm_rr)
+        assert aff_hits > rr_hits
+
+        # ---- kill the session's replica MID-STREAM
+        faults.set_plan("engine.dispatch=delay:0.05")  # stretch decode
+        try:
+            resp = await client.post(
+                "/generate",
+                json={"question": _convo_words("aff-kill", 40),
+                      "context": _convo_words("sys-aff", 600),
+                      "use_knowledge_base": False, "num_tokens": 48},
+                headers={"X-Request-ID": "acc-kill"})
+            assert resp.status == 200
+            assert resp.headers["X-Routed-Replica"] == home
+            await resp.content.read(1)  # streaming has begun
+            killed[home_i] = True
+            servers[home_i].kill()
+            tail = (await resp.content.read()).decode(
+                "utf-8", errors="replace")
+        finally:
+            faults.clear()
+        # real, machine-readable error frame — not a hang, not silence
+        assert "event: error" in tail and "replica_lost" in tail, tail
+
+        # failover within one heartbeat: the loss already marked the
+        # replica unreachable; the NEXT turn lands on the survivor fast.
+        t0 = time.monotonic()
+        resp = await client.post(
+            "/generate",
+            json={"question": _convo_words("aff-after", 40),
+                  "context": _convo_words("sys-aff", 600),
+                  "use_knowledge_base": False, "num_tokens": 8})
+        assert resp.status == 200
+        other = f"r{1 - home_i}"
+        assert resp.headers["X-Routed-Replica"] == other
+        await resp.read()
+        assert time.monotonic() - t0 < 30  # bounded, compile included
+        snap = await (await client.get("/router/replicas")).json()
+        dead = next(r for r in snap["replicas"] if r["name"] == home)
+        assert not dead["placeable"]
+        await client.close()
+
+    try:
+        _run(fn())
+    finally:
+        for i, s in enumerate(servers):
+            if not killed[i]:
+                try:
+                    s.kill()
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass

@@ -76,7 +76,7 @@ def validate_result(result: dict, schema: dict | None = None) -> None:
     errors: list[str] = []
     _check_types("result", result, schema["top_level"], errors)
     for section in ("engine_pipeline", "e2e_ttft_dist_ms", "chat",
-                    "openloop"):
+                    "openloop", "fleet"):
         sub = result.get(section)
         if isinstance(sub, dict):
             _check_types(section, sub, schema[section], errors)
@@ -94,6 +94,20 @@ def validate_result(result: dict, schema: dict | None = None) -> None:
                 else:
                     errors.append(
                         f"openloop.rates[{i}]: {entry!r} is not an object")
+    # Fleet sweep: each per-policy entry carries the cross-replica
+    # prefix-hit / SLO headline fields — validated element-wise so a
+    # rename in one policy's dict can't hide behind the list type.
+    fleet = result.get("fleet")
+    if isinstance(fleet, dict):
+        policies = fleet.get("policies")
+        if isinstance(policies, list):
+            for i, entry in enumerate(policies):
+                if isinstance(entry, dict):
+                    _check_types(f"fleet.policies[{i}]", entry,
+                                 schema["fleet_policy"], errors)
+                else:
+                    errors.append(
+                        f"fleet.policies[{i}]: {entry!r} is not an object")
     breakdown = result.get("e2e_breakdown_ms")
     if isinstance(breakdown, dict):
         allowed = set(schema["breakdown_stages"])

@@ -1,23 +1,30 @@
-"""Verify docs/observability.md's engine gauge table against the engine's
-actual ``stats()`` surface (mirror of tools/check_bench_schema.py for the
-metrics docs).
+"""Verify docs/observability.md's metric tables against the code's actual
+metric surfaces (mirror of tools/check_bench_schema.py for the metrics
+docs).
 
-The chain server mirrors every numeric ``Engine.stats()`` key as an
-``engine_*`` gauge at scrape time (obs/metrics.py record_engine_stats), and
-docs/observability.md documents each one in a table fenced by
+Two fenced tables, each enforced BOTH ways:
 
-    <!-- engine-stats:begin --> ... <!-- engine-stats:end -->
+- **Engine gauges.** The chain server mirrors every numeric
+  ``Engine.stats()`` key as an ``engine_*`` gauge at scrape time
+  (obs/metrics.py record_engine_stats); the table between
 
-This checker enforces BOTH directions inside that fence:
+      <!-- engine-stats:begin --> ... <!-- engine-stats:end -->
 
-- every documented ``engine_<key>`` gauge corresponds to a real stats key
-  (or a known derived gauge: the ``_avg`` pairs record_engine_stats
-  computes) — so a stats rename can't leave the docs describing a ghost;
-- every stats key is documented — so a new counter can't ship invisible.
+  must document exactly those keys (plus the known derived ``_avg``
+  gauges) — a stats rename can't leave the docs describing a ghost, and
+  a new counter can't ship invisible.
 
-Registry-level metrics that are NOT stats mirrors (the labeled
-``engine_stage_seconds`` histogram) live OUTSIDE the fence and are not
-checked here.
+- **Router metrics.** The fleet router declares its whole surface in
+  ``router.metrics.ROUTER_METRICS``; the table between
+
+      <!-- router-metrics:begin --> ... <!-- router-metrics:end -->
+
+  must document exactly those names — same contract, same failure
+  modes.
+
+Registry-level metrics that are NOT part of either surface (the labeled
+``engine_stage_seconds`` histogram, ``shed_total``...) live OUTSIDE the
+fences and are not checked here.
 
 Runs in tier-1 via tests/test_metrics_docs.py; CLI:
 ``python tools/check_metrics_docs.py`` exits non-zero listing every
@@ -34,20 +41,36 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DOC_PATH = os.path.join(REPO, "docs", "observability.md")
 BEGIN = "<!-- engine-stats:begin -->"
 END = "<!-- engine-stats:end -->"
+ROUTER_BEGIN = "<!-- router-metrics:begin -->"
+ROUTER_END = "<!-- router-metrics:end -->"
 
 _GAUGE_RE = re.compile(r"`engine_([a-z0-9_]+)`")
+_ROUTER_RE = re.compile(r"`router_([a-z0-9_]+)")  # name may carry {label=}
+
+
+def _fenced(doc_text: str, begin: str, end: str) -> str:
+    try:
+        start = doc_text.index(begin) + len(begin)
+        stop = doc_text.index(end, start)
+    except ValueError:
+        raise SystemExit(
+            f"{DOC_PATH}: missing {begin}/{end} markers around the "
+            f"metric table — the docs checker needs them to scope its "
+            f"scan")
+    return doc_text[start:stop]
 
 
 def documented_gauges(doc_text: str) -> set[str]:
     """engine_* names inside the fenced gauge table (backtick-quoted)."""
-    try:
-        start = doc_text.index(BEGIN) + len(BEGIN)
-        end = doc_text.index(END, start)
-    except ValueError:
-        raise SystemExit(
-            f"{DOC_PATH}: missing {BEGIN}/{END} markers around the engine "
-            f"gauge table — the docs checker needs them to scope its scan")
-    return {"engine_" + m for m in _GAUGE_RE.findall(doc_text[start:end])}
+    return {"engine_" + m
+            for m in _GAUGE_RE.findall(_fenced(doc_text, BEGIN, END))}
+
+
+def documented_router_metrics(doc_text: str) -> set[str]:
+    """router_* names inside the router fence (label suffixes like
+    ``{replica=}`` are part of the docs prose, not the name)."""
+    return {"router_" + m for m in _ROUTER_RE.findall(
+        _fenced(doc_text, ROUTER_BEGIN, ROUTER_END))}
 
 
 def expected_gauges() -> tuple[set[str], set[str]]:
@@ -59,8 +82,13 @@ def expected_gauges() -> tuple[set[str], set[str]]:
     return stats, derived
 
 
+def expected_router_metrics() -> set[str]:
+    from generativeaiexamples_tpu.router.metrics import ROUTER_METRICS
+    return set(ROUTER_METRICS)
+
+
 def check(doc_text: str | None = None) -> list[str]:
-    """Every mismatch between the docs table and the stats surface;
+    """Every mismatch between the docs tables and the code surfaces;
     empty on a clean tree."""
     if doc_text is None:
         with open(DOC_PATH) as f:
@@ -76,6 +104,17 @@ def check(doc_text: str | None = None) -> list[str]:
         errors.append(
             f"Engine.stats() exposes {name} but docs/observability.md's "
             f"gauge table does not document it")
+    doc_router = documented_router_metrics(doc_text)
+    router = expected_router_metrics()
+    for name in sorted(doc_router - router):
+        errors.append(
+            f"docs/observability.md documents {name} but "
+            f"router.metrics.ROUTER_METRICS has no such metric (stale "
+            f"doc after a router rename?)")
+    for name in sorted(router - doc_router):
+        errors.append(
+            f"router.metrics.ROUTER_METRICS declares {name} but "
+            f"docs/observability.md's router table does not document it")
     return errors
 
 
@@ -85,7 +124,8 @@ def main() -> int:
         for e in errors:
             print(f"FAIL — {e}")
         return 1
-    print(f"{DOC_PATH}: engine gauge table in sync with Engine.stats()")
+    print(f"{DOC_PATH}: engine gauge table in sync with Engine.stats(); "
+          f"router table in sync with ROUTER_METRICS")
     return 0
 
 
