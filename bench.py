@@ -29,7 +29,10 @@ BENCH_STEPS_PER_ROUND, BENCH_DISPATCH_DEPTH, BENCH_SKIP_E2E,
 BENCH_SKIP_CHAT, BENCH_CHAT_TURNS, BENCH_CHAT_SYSTEM (multi-turn chat
 scenario: warm shared-prefix TTFT vs cold, engine prefix cache);
 BENCH_MODEL_PATH points at a real checkpoint dir (weights + tokenizer
-loaded via the import pipeline instead of random init).
+loaded via the import pipeline instead of random init);
+BENCH_SLOTS_SWEEP=8,16,32,64 additionally runs the slots-ladder
+capacity sweep (one engine per rung, schema-validated ``capacity``
+section — per-rung TTFT/throughput/HBM roofline).
 
 Degradation ladder (each rung covers build AND warmup/run, since on
 tunneled devices allocation is lazy and OOM surfaces at first execution):
@@ -49,21 +52,9 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 TTFT_BASELINE_MS = 200.0
 
-# Peak HBM bandwidth (bytes/s) by TPU generation, public spec numbers.
-PEAK_HBM_BW = {
-    "v4": 1.2e12,
-    "v5 lite": 819e9, "v5e": 819e9,
-    "v5p": 2.76e12,
-    "v6 lite": 1.64e12, "v6e": 1.64e12,
-}
-
-
-def _peak_bw(device) -> float:
-    kind = getattr(device, "device_kind", "").lower()
-    for key, bw in PEAK_HBM_BW.items():
-        if key in kind:
-            return bw
-    return 819e9  # assume v5e if unknown
+# Single-sourced roofline denominator (utils/hbm.py) — profile_decode
+# reads the same table, so both artifacts agree per hardware.
+from generativeaiexamples_tpu.utils.hbm import peak_bw as _peak_bw  # noqa: E402
 
 
 def tree_bytes(tree) -> int:
@@ -490,6 +481,88 @@ def serve_apps(apps: list):
     return [f"http://127.0.0.1:{p}" for p in box["ports"]], stop
 
 
+def run_capacity_sweep(params, model_cfg, tokenizer, rungs, *,
+                       prompt_len: int, out_len: int, n_requests: int,
+                       kv_quant: str = "", steps_per_round: int = 16,
+                       **engine_overrides):
+    """Slots-ladder capacity sweep (``BENCH_SLOTS_SWEEP=8,16,32,64``):
+    one engine per slot rung over SHARED params, each run through the
+    closed-loop TTFT + steady-decode measurement and the HBM roofline —
+    the BENCH_SWEEP_r05-style capacity table as one automated,
+    schema-validated ``capacity`` section instead of N hand-rolled
+    single-rung bench invocations.
+
+    Each rung's pool is sized to hold every slot's full decode window
+    (prompt + 2x output, rounded UP to the engine's power-of-two window
+    rung — the jnp fallback path gathers the bucketed window, not the
+    exact page count) so ``decode_window_steady`` holds by construction
+    on both kernel and fallback paths and the per-rung roofline number
+    is comparable across the ladder; ``BENCH_SWEEP_KV_POOL_TOKENS``
+    overrides (per-slot tokens) for HBM-constrained sweeps."""
+    from generativeaiexamples_tpu.engine import Engine, EngineConfig
+
+    page = int(engine_overrides.get("page_size", 128))
+    need_pages = -(-(prompt_len + 2 * out_len + 2) // page)
+    win_pages = 1
+    while win_pages < need_pages:
+        win_pages *= 2
+    per_slot = int(os.environ.get("BENCH_SWEEP_KV_POOL_TOKENS", "0")) \
+        or win_pages * page
+    out = []
+    for slots in rungs:
+        # engine_overrides (tests: tiny page/bucket geometry) win over
+        # the production defaults below.
+        kw = dict(
+            max_slots=slots, max_input_length=max(2048, prompt_len + 8),
+            max_output_length=max(128, 2 * out_len),
+            prefill_buckets=(512, 1024), dtype="bfloat16",
+            kv_pool_tokens=slots * per_slot + page,
+            kv_quant=kv_quant,
+            steps_per_round=steps_per_round,
+            dispatch_depth=int(os.environ.get("BENCH_DISPATCH_DEPTH",
+                                              "2")))
+        kw.update(engine_overrides)
+        kw["max_slots"] = slots
+        engine = Engine(params, model_cfg, tokenizer, EngineConfig(**kw))
+        try:
+            engine.prewarm()
+            p50, p99, tput, _ = run_engine_bench(
+                engine, prompt_len, out_len, n_requests, slots)
+            achieved, util, steady = hbm_utilization(
+                engine, model_cfg, tput, slots, prompt_len, out_len)
+            stats = engine.stats
+            rows = int(stats.get("sampler_rows_sampled", 0))
+            skipped = int(stats.get("sampler_rows_skipped", 0))
+            out.append({
+                "slots": slots,
+                "engine_p50_ttft_ms": round(p50, 2),
+                "engine_p99_ttft_ms": round(p99, 2),
+                "decode_tokens_per_sec": round(tput, 1),
+                "tokens_per_sec_per_slot": round(tput / slots, 1),
+                "hbm_bw_achieved_gbps": round(achieved / 1e9, 1),
+                "hbm_bw_util": round(util, 3),
+                "decode_window_steady": steady,
+                # Fused-tail occupancy: fraction of unembed/sampler rows
+                # the active-slot compaction skipped (partial occupancy
+                # during ramp-up/drain — proves the tail is sized to
+                # occupancy, not max_slots).
+                "sampler_rows_skipped_frac": round(
+                    skipped / max(1, rows + skipped), 3),
+            })
+        finally:
+            engine.stop()
+        import gc
+        gc.collect()
+    return {
+        "slots_sweep": list(rungs),
+        "prompt_len": prompt_len,
+        "output_len": out_len,
+        "requests_per_rung": n_requests,
+        "kv_pool_tokens_per_slot": per_slot,
+        "rungs": out,
+    }
+
+
 def build_fleet_engines(params, model_cfg, tokenizer, n: int):
     """N small replica engines over SHARED params (read-only on device —
     weights are never duplicated) with explicit, modest KV pools
@@ -751,7 +824,7 @@ def assemble_result(*, kind, model, headline, engine_p50, engine_p99, tput,
                     weights, prompt_len, out_len, slots, steps_per_round,
                     kv_pool_pages, device, rtt_ms, n_devices,
                     bench_seconds, e2e_tps_p50=None, openloop=None,
-                    fleet=None) -> dict:
+                    fleet=None, capacity=None) -> dict:
     """The bench's single output contract. Every field name here is
     pinned by tools/bench_schema.json (validated at emit time AND by the
     tier-1 suite, tests/test_bench_schema.py) so a rename fails fast
@@ -795,6 +868,10 @@ def assemble_result(*, kind, model, headline, engine_p50, engine_p99, tput,
         # prefix_hit_rate and SLO attainment per policy. Null when the
         # fleet is not requested.
         "fleet": fleet,
+        # Slots-ladder capacity sweep (BENCH_SLOTS_SWEEP): per-rung
+        # TTFT/throughput/HBM-roofline — the BENCH_SWEEP_rNN table as
+        # one validated section. Null when the sweep is not requested.
+        "capacity": capacity,
         "quantization": quant,
         "kv_quant": kv_quant,
         "weights": weights,
@@ -1170,6 +1247,25 @@ def main() -> None:
     finally:
         engine.stop()
 
+    # Capacity sweep (BENCH_SLOTS_SWEEP=8,16,32,64): per-rung engines
+    # over the measured model's params, run with the main engine STOPPED
+    # (its auto-sized pool released is not possible — params stay held —
+    # so rung pools are sized explicitly). Degrades to capacity=null.
+    capacity = None
+    sweep_env = os.environ.get("BENCH_SLOTS_SWEEP", "")
+    if sweep_env:
+        try:
+            capacity = run_capacity_sweep(
+                engine.params, model_cfg, engine.tokenizer,
+                [int(s) for s in sweep_env.split(",") if s],
+                prompt_len=prompt_len, out_len=out_len,
+                n_requests=int(os.environ.get("BENCH_SWEEP_REQUESTS",
+                                              "8")),
+                kv_quant=engine.cfg.kv_quant,
+                steps_per_round=engine.cfg.steps_per_round)
+        except Exception as exc:  # noqa: BLE001
+            sys.stderr.write(f"bench: capacity sweep failed: {exc}\n")
+
     # Fleet scenario (BENCH_REPLICAS >= 2): the router over N fresh
     # in-process replicas sharing the measured model's params. Runs with
     # the main engine STOPPED (its pool idle) and explicit small replica
@@ -1213,6 +1309,7 @@ def main() -> None:
         chat=chat, e2e_p50=e2e_p50, e2e_dist=e2e_dist,
         e2e_breakdown=e2e_breakdown, e2e_tps_p50=e2e_tps_p50,
         pipeline=pipeline, openloop=openloop, fleet=fleet,
+        capacity=capacity,
         quant=quant, kv_quant=engine.cfg.kv_quant or None,
         weights=("real" if os.environ.get("BENCH_MODEL_PATH")
                  else "random-init"),

@@ -61,7 +61,10 @@ from ..models.configs import LlamaConfig
 from ..models.tokenizer import Tokenizer
 from ..obs import flight as obs_flight
 from ..obs.tracing import record_stage
-from ..ops.sampling import apply_repetition_penalty, sample, seen_mask
+from ..ops.fused_sampler import fused_unembed_sample
+from ..ops.sampling import (apply_repetition_penalty, mask_words,
+                            pack_mask, pack_mask_np, sample, seen_mask,
+                            set_token_bits, unpack_mask)
 from ..parallel.sharding import (llama_param_specs, paged_kv_cache_spec,
                                  shard_params)
 from ..utils import faults
@@ -74,6 +77,18 @@ from .scheduler import PrefillJob, StepCostModel, TokenBudgetScheduler
 
 def _ceil_div(a: int, b: int) -> int:
     return -(-a // b)
+
+
+def _pow2_ladder(top: int) -> tuple:
+    """(1, 2, 4, ..., top): the compiled-shape rungs for decode page
+    windows and fused-tail active-row counts — a request pays for the
+    smallest rung covering it, not the maximum."""
+    ladder = []
+    w = 1
+    while w < top:
+        ladder.append(w)
+        w *= 2
+    return tuple(ladder + [top])
 
 
 # Engine-owned cumulative counters, the keys ``stats()`` always carries.
@@ -109,6 +124,13 @@ _STATS_TEMPLATE = {
     "sched_prefill_tokens": 0,
     "sched_decode_tokens": 0,
     "sched_interleaved_rounds": 0,
+    # Fused unembed/sampling tail (ops/fused_sampler.py): slot-rows that
+    # actually ran through the vocab projection + sampler per decode
+    # step, vs rows the former all-slots tail would have computed but the
+    # active-slot compaction skipped (partial occupancy — the proof the
+    # tail no longer pays for empty slots).
+    "sampler_rows_sampled": 0,
+    "sampler_rows_skipped": 0,
 }
 
 
@@ -574,14 +596,34 @@ class Engine:
         self._stats["sched_round_budget_tokens"] = \
             self._sched.round_budget_tokens
         # Decode-attention page windows: power-of-two ladder up to the max.
-        ladder = []
-        w = 1
-        while w < self._pmax:
-            ladder.append(w)
-            w *= 2
-        self._windows = tuple(ladder + [self._pmax])
+        self._windows = _pow2_ladder(self._pmax)
+
+        # Fused vocab-tiled unembed+sampling tail (ops/fused_sampler.py):
+        # single-chip only — under a mesh the lm_head may shard over the
+        # vocab axis, and the per-tile dynamic_slice would need a
+        # collective per tile; mesh serving keeps the materialized tail.
+        # ENGINE_FUSED_SAMPLER=0 forces the materialized tail anywhere
+        # (it doubles as the parity oracle in tests).
+        self._fused_tail = (self.mesh is None and os.environ.get(
+            "ENGINE_FUSED_SAMPLER", "1") != "0")
+        # Active-row ladder for the fused tail: decode rounds gather the
+        # armed slots into the smallest rung >= the live count, so the
+        # unembed/sampling tail is sized to OCCUPANCY, not max_slots.
+        # Two rungs only — {1, B} — on purpose: every rung multiplies
+        # the decode-round compile ladder (each (window, steps, greedy)
+        # variant recompiles per rung, seconds of serve-loop stall per
+        # crossing on a real model), while the tail's cost is dominated
+        # by the row-count-INDEPENDENT lm_head tile stream, so the
+        # single-stream rung captures nearly all the win. prewarm()
+        # compiles both rungs through the real serving path.
+        self._ba_ladder = (1, B) if B > 1 else (1,)
 
         self._build_jitted()
+
+    def _ba_for(self, n: int) -> int:
+        """Smallest active-row rung covering ``n`` armed slots."""
+        n = max(1, n)
+        return next(b for b in self._ba_ladder if b >= n)
 
     def _init_device_state(self) -> dict:
         """Fresh device-side scheduler state (cache pool + slot arrays).
@@ -606,8 +648,15 @@ class Engine:
             "top_k": jnp.zeros((B,), jnp.int32),
             "top_p": jnp.zeros((B,), jnp.float32),
             "rep_pen": jnp.ones((B,), jnp.float32),
-            "seen": jnp.zeros((B, mcfg.vocab_size), bool),
-            "banned": jnp.zeros((B, mcfg.vocab_size), bool),
+            # Seen/banned vocab masks as uint32 BITFIELDS (32 tokens per
+            # word, ops/sampling.py pack_mask): 1 bit per token instead
+            # of a byte-bool — 8x less mask state and per-step mask
+            # traffic, and the fused sampler slices whole words per
+            # vocab tile.
+            "seen": jnp.zeros((B, mask_words(mcfg.vocab_size)),
+                              jnp.uint32),
+            "banned": jnp.zeros((B, mask_words(mcfg.vocab_size)),
+                                jnp.uint32),
             # Multi-token bad-words: per-slot sequence table (padded with
             # -1), per-sequence lengths, and a ring of the last L-1
             # generated tokens the match runs against. -1 padding can never
@@ -926,15 +975,34 @@ class Engine:
                 raise (self._fatal or exc) from exc
             if stream.finish_reason == "error":
                 raise self._fatal or EngineError("prewarm serve failed")
+            # Warm the FULL-WIDTH active-row rung through the real path:
+            # the request above compiled the single-stream decode round
+            # (ba rung 1); two short concurrent streams force a
+            # multi-slot round so the first real occupancy crossing
+            # doesn't pay that compile on the serve loop mid-traffic.
+            dummies = 1
+            if self.cfg.max_slots > 1 and self._fused_tail:
+                pair = [self.submit(
+                    ids[:min(16, len(ids))], _SP(
+                        max_tokens=self.cfg.steps_per_round + 1,
+                        top_k=1, ignore_eos=True),
+                    request_id=f"engine-prewarm-b{i}") for i in range(2)]
+                dummies += 2
+                for s in pair:
+                    for _ in s:
+                        pass
+                    if s.finish_reason == "error":
+                        raise self._fatal or EngineError(
+                            "prewarm rung warm failed")
         finally:
             try:
                 self.stop()
             except Exception:  # noqa: BLE001 — post-fatal cleanup only
                 pass
             del slack
-        # Scrub the dummy from served stats.
+        # Scrub the dummies from served stats.
         with self._stats_lock:
-            self._stats["requests"] -= 1
+            self._stats["requests"] -= dummies
 
     @property
     def flight(self) -> obs_flight.FlightRecorder:
@@ -989,10 +1057,12 @@ class Engine:
         def prefill(params, tokens, length, temp, top_k, top_p, rep_pen,
                     banned, key, greedy: bool):
             """tokens: (1, S_bucket); returns (k,v) for the bucket, the
-            sampled first token, and the prompt's seen-token mask.
-            ``banned``: (V,) bool bad-words token mask. ``greedy`` is a
-            trace-time flag: the greedy variant is a pure argmax — no
-            vocab sort on the TTFT-critical path.
+            sampled first token, and the prompt's seen-token mask as a
+            (Wn,) uint32 bitfield. ``banned``: (Wn,) uint32 bad-words
+            bitfield (unpacked transiently here — admission runs once
+            per request; the per-STEP decode path never unpacks).
+            ``greedy`` is a trace-time flag: the greedy variant is a
+            pure argmax — no vocab sort on the TTFT-critical path.
 
             Under a dp×sp mesh the forward is the RING-ATTENTION prefill
             (llama.apply_prefill_sp): bucket activations shard over sp,
@@ -1019,14 +1089,15 @@ class Engine:
             seen = seen_mask(tokens, length[None], mcfg.vocab_size)  # (1, V)
             last = apply_repetition_penalty(last[None, :], seen,
                                             rep_pen[None])
-            last = jnp.where(banned[None, :], -1e30, last)
+            last = jnp.where(unpack_mask(banned, mcfg.vocab_size)[None, :],
+                             -1e30, last)
             if greedy:
                 first_tok = jnp.argmax(last[0].astype(jnp.float32)
                                        ).astype(jnp.int32)
             else:
                 first_tok = sample(last, key, temp[None], top_k[None],
                                    top_p[None])[0]
-            seen = seen[0].at[first_tok].set(True)
+            seen = pack_mask(seen[0].at[first_tok].set(True))  # (Wn,) u32
             return cache["k"], cache["v"], first_tok, seen
 
         def insert(state, k_new, v_new, slot, length, first_tok,
@@ -1092,15 +1163,46 @@ class Engine:
                     .at[-1].set(first_tok)),
             }
 
-        def make_round(window: int, steps: int, greedy: bool):
-            def decode_round(params, state, key):
+        def bad_seq_hits(seq, blen, recent):
+            """Multi-token bad-words: a sequence of length l is banned by
+            masking its LAST token whenever the l-1 most recent generated
+            tokens equal its prefix. Returns (hit (R, W) bool,
+            tail (R, W) int32) — the compare is (R, W, L) int32, noise
+            next to the vocab work around it."""
+            R, W_, Lb = seq.shape
+            slen = recent.shape[1]
+            j = jnp.arange(Lb, dtype=jnp.int32)
+            # seq position j aligns with ring index Lb - l + j
+            gi = jnp.clip(Lb - blen[..., None] + j, 0, slen - 1)
+            hist = jnp.take_along_axis(
+                jnp.broadcast_to(recent[:, None, :], (R, W_, slen)),
+                gi, axis=2)
+            need = j[None, None, :] < (blen[..., None] - 1)
+            hit = ((hist == seq) | ~need).all(-1) & (blen >= 2)
+            tail = jnp.take_along_axis(
+                seq, jnp.maximum(blen - 1, 0)[..., None], axis=2)[..., 0]
+            return hit, tail
+
+        def make_round(window: int, steps: int, greedy: bool, ba: int):
+            fused = self._fused_tail
+            V = mcfg.vocab_size
+
+            def decode_round(params, state, key, act_idx):
                 """K decode steps fused in one dispatch; returns (K, B)
                 tokens with -1 for slots inactive at step entry. eos and
                 length termination happen on-device (``active`` drops), so
-                the host only needs one transfer per round. The greedy
-                variant (every member slot top_k==1) replaces the full
-                vocab-sort sampler with an argmax — the sort is the single
-                most expensive non-matmul op in the step."""
+                the host only needs one transfer per round.
+
+                ``act_idx``: (ba,) armed-slot indices, padded with B
+                (out of bounds: gathers clamp to a throwaway row, token
+                scatters drop). The FUSED tail gathers those rows and
+                runs the vocab-tiled unembed+sampler on (ba, …) shapes
+                only — a half-empty engine no longer unembeds max_slots
+                rows — and never materializes (B, V) penalized logits or
+                bool masks (ops/fused_sampler.py). The materialized tail
+                remains for mesh serving / ENGINE_FUSED_SAMPLER=0 and as
+                the parity oracle; the greedy variant of either tail is
+                a pure argmax (no vocab sort / no sampling noise)."""
                 def body(st, key_k):
                     pos, active = st["pos"], st["active"]
                     page_of = jnp.take_along_axis(
@@ -1110,45 +1212,59 @@ class Engine:
                     # loop trips ceil(pos/page) times — an inactive slot
                     # (pos -> 0) streams nothing, so dead slots cost no HBM.
                     eff_pos = jnp.where(active, pos, 0)
-                    logits, cache = llama.apply_decode_paged(
+                    net, cache = llama.apply_decode_paged(
                         params, mcfg, st["last_token"][:, None],
                         eff_pos[:, None], st["cache"], st["table"][:, :window],
                         pos + 1, wp, eff_pos % page,
-                        use_kernel=self._use_kernel, mesh=self.mesh)
-                    penalized = apply_repetition_penalty(
-                        logits[:, 0], st["seen"], st["rep_pen"])
-                    penalized = jnp.where(st["banned"], -1e30, penalized)
-                    # Multi-token bad-words: a sequence of length l is
-                    # banned by masking its LAST token whenever the l-1
-                    # most recent generated tokens equal its prefix. The
-                    # compare is (B, W, L) int32 — noise next to the
-                    # (B, V) vocab masks above.
-                    seq, slen = st["bad_seq"], st["recent"].shape[1]
-                    Lb = seq.shape[2]
-                    blen = st["bad_len"]
-                    j = jnp.arange(Lb, dtype=jnp.int32)
-                    # seq position j aligns with ring index Lb - l + j
-                    gi = jnp.clip(Lb - blen[..., None] + j, 0, slen - 1)
-                    hist = jnp.take_along_axis(
-                        jnp.broadcast_to(st["recent"][:, None, :],
-                                         (B, seq.shape[1], slen)),
-                        gi, axis=2)
-                    need = j[None, None, :] < (blen[..., None] - 1)
-                    hit = ((hist == seq) | ~need).all(-1) & (blen >= 2)
-                    tail = jnp.take_along_axis(
-                        seq, jnp.maximum(blen - 1, 0)[..., None],
-                        axis=2)[..., 0]
-                    penalized = penalized.at[
-                        jnp.arange(B)[:, None],
-                        jnp.where(hit, tail, 0)].min(
-                        jnp.where(hit, -1e30, jnp.inf).astype(
-                            penalized.dtype))
-                    if greedy:
-                        tok = jnp.argmax(penalized.astype(jnp.float32),
-                                         axis=-1).astype(jnp.int32)
+                        use_kernel=self._use_kernel, mesh=self.mesh,
+                        return_hidden=fused)
+                    if fused:
+                        hn = llama.unembed_norm(params, mcfg,
+                                                net[:, 0])       # (B, D)
+                        ha = hn[act_idx]                         # (ba, D)
+                        hit, tail = bad_seq_hits(st["bad_seq"][act_idx],
+                                                 st["bad_len"][act_idx],
+                                                 st["recent"][act_idx])
+
+                        def tile_fn(t0, tile):
+                            return llama.lm_head_tile(params, mcfg, ha,
+                                                      t0, tile)
+
+                        tok_a = fused_unembed_sample(
+                            tile_fn, V, key=key_k,
+                            temp=st["temp"][act_idx],
+                            top_k=st["top_k"][act_idx],
+                            top_p=st["top_p"][act_idx],
+                            rep_pen=st["rep_pen"][act_idx],
+                            seen_words=st["seen"][act_idx],
+                            banned_words=st["banned"][act_idx],
+                            ban_tok=tail, ban_hit=hit, greedy=greedy)
+                        # padding indices (== B) drop on scatter; rows not
+                        # in act_idx are inactive, so their (unused) token
+                        # defaults to 0 and every update below masks on
+                        # ``active``.
+                        tok = jnp.zeros((B,), jnp.int32).at[
+                            act_idx].set(tok_a)
                     else:
-                        tok = sample(penalized, key_k, st["temp"],
-                                     st["top_k"], st["top_p"])
+                        penalized = apply_repetition_penalty(
+                            net[:, 0], unpack_mask(st["seen"], V),
+                            st["rep_pen"])
+                        penalized = jnp.where(unpack_mask(st["banned"], V),
+                                              -1e30, penalized)
+                        hit, tail = bad_seq_hits(st["bad_seq"],
+                                                 st["bad_len"],
+                                                 st["recent"])
+                        penalized = penalized.at[
+                            jnp.arange(B)[:, None],
+                            jnp.where(hit, tail, 0)].min(
+                            jnp.where(hit, -1e30, jnp.inf).astype(
+                                penalized.dtype))
+                        if greedy:
+                            tok = jnp.argmax(penalized.astype(jnp.float32),
+                                             axis=-1).astype(jnp.int32)
+                        else:
+                            tok = sample(penalized, key_k, st["temp"],
+                                         st["top_k"], st["top_p"])
                     emitted = jnp.where(active, tok, -1)
                     remaining = jnp.where(active, st["remaining"] - 1,
                                           st["remaining"])
@@ -1160,7 +1276,7 @@ class Engine:
                         last_token=jnp.where(active, tok, st["last_token"]),
                         active=active & ~finished,
                         remaining=remaining,
-                        seen=st["seen"].at[jnp.arange(B), tok].max(active),
+                        seen=set_token_bits(st["seen"], tok, active),
                         recent=jnp.where(
                             active[:, None],
                             jnp.concatenate([st["recent"][:, 1:],
@@ -1201,11 +1317,11 @@ class Engine:
         self._round_fns: dict[tuple[int, int, bool], object] = {}
         self._chunk_fns: dict[tuple, object] = {}
 
-    def _round_fn(self, window: int, steps: int, greedy: bool):
-        key = (window, steps, greedy)
+    def _round_fn(self, window: int, steps: int, greedy: bool, ba: int):
+        key = (window, steps, greedy, ba)
         fn = self._round_fns.get(key)
         if fn is None:
-            fn = jax.jit(self._make_round(window, steps, greedy),
+            fn = jax.jit(self._make_round(window, steps, greedy, ba),
                          donate_argnums=(1,))
             self._round_fns[key] = fn
         return fn
@@ -1219,12 +1335,14 @@ class Engine:
         go). ``mode``: "replace" (chunk 0 of a cold chunked admission —
         drop the previous occupant's stale mask), "accum" (OR into the
         slot's mask), or "seed" (chunk 0 of a prefix-cache hit: OR into
-        ``seen0``, the host-built mask over the cached prefix tokens the
-        chunks never revisit)."""
+        ``seen0``, the host-built PACKED mask over the cached prefix
+        tokens the chunks never revisit). All forms are uint32 bitfields
+        (ops/sampling.py pack_mask); OR on packed words == OR on the
+        bool masks they encode."""
         C = tokens.shape[1]
         in_chunk = jnp.clip(valid - start, 0, C)
-        chunk_seen = seen_mask(tokens, in_chunk[None],
-                               self.model_cfg.vocab_size)[0]
+        chunk_seen = pack_mask(seen_mask(tokens, in_chunk[None],
+                                         self.model_cfg.vocab_size)[0])
         if mode == "accum":
             chunk_seen = state["seen"][slot] | chunk_seen
         elif mode == "seed":
@@ -1292,10 +1410,16 @@ class Engine:
                 idx = jnp.clip(valid - start - 1, 0, C - 1)
                 h_last = jnp.take_along_axis(
                     h, idx[None, None, None].astype(jnp.int32), axis=1)
+                # Admission runs once per request — unpacking the packed
+                # masks transiently here is fine; the per-STEP decode
+                # path never unpacks.
+                V = mcfg.vocab_size
                 last = llama.unembed(params, mcfg, h_last)[0, 0]  # (V,)
                 last = apply_repetition_penalty(
-                    last[None, :], seen[slot][None, :], rep_pen[None])
-                last = jnp.where(banned[None, :], -1e30, last)
+                    last[None, :], unpack_mask(seen[slot], V)[None, :],
+                    rep_pen[None])
+                last = jnp.where(unpack_mask(banned, V)[None, :],
+                                 -1e30, last)
                 if greedy:
                     first_tok = jnp.argmax(
                         last[0].astype(jnp.float32)).astype(jnp.int32)
@@ -1317,7 +1441,9 @@ class Engine:
                     top_k=state["top_k"].at[slot].set(top_k),
                     top_p=state["top_p"].at[slot].set(top_p),
                     rep_pen=state["rep_pen"].at[slot].set(rep_pen),
-                    seen=seen.at[jnp.asarray(slot), first_tok].set(True),
+                    seen=seen.at[jnp.asarray(slot)].set(
+                        set_token_bits(seen[slot][None], first_tok[None],
+                                       jnp.ones((1,), bool))[0]),
                     banned=state["banned"].at[slot].set(banned),
                     bad_seq=state["bad_seq"].at[slot].set(bad_seq),
                     bad_len=state["bad_len"].at[slot].set(bad_len),
@@ -1565,7 +1691,10 @@ class Engine:
     def _render_bad_words(self, banned_ids: list[int],
                           bad_seqs: list[list[int]]):
         """Device-ready numpy renderings, built on the SUBMITTING thread
-        so the serve loop's admission dispatch does no mask assembly."""
+        so the serve loop's admission dispatch does no mask assembly.
+        The banned mask ships PACKED (uint32 bitfield, 32 tokens/word —
+        ops/sampling.py): 1/8 the upload bytes and the exact layout the
+        device state stores per slot."""
         banned_row = np.zeros((self.model_cfg.vocab_size,), bool)
         if banned_ids:
             banned_row[banned_ids] = True
@@ -1575,7 +1704,7 @@ class Engine:
         for i, seq in enumerate(bad_seqs):
             seq_tbl[i, :len(seq)] = seq
             seq_len[i] = len(seq)
-        return banned_row, seq_tbl, seq_len
+        return pack_mask_np(banned_row), seq_tbl, seq_len
 
     # -------------------------------------------------------- fused RAG
 
@@ -2285,11 +2414,13 @@ class Engine:
         if start_tok > 0:
             # Prefix-cache hit: the seen (repetition-penalty) mask over
             # the skipped prefix is rebuilt host-side from the prompt
-            # itself and seeded into the first chunk's dispatch.
+            # itself and seeded into the first chunk's dispatch (packed,
+            # same uint32 bitfield layout as the device state).
             V = self.model_cfg.vocab_size
             seen0 = np.zeros((V,), bool)
             ids = np.asarray(req.prompt_ids[:start_tok], np.int64)
             seen0[ids[(ids >= 0) & (ids < V)]] = True
+            seen0 = pack_mask_np(seen0)
         req.pf = {
             "row": row, "row_win": jnp.asarray(row_ext[None, :]),
             "window": window, "start_tok": start_tok,
@@ -2505,10 +2636,24 @@ class Engine:
             window = self._window_for(_ceil_div(need, self.cfg.page_size))
         greedy = all(r.greedy for r in members.values())
         key = jax.random.fold_in(self._base_key, next(self._step_counter))
-        new_state, toks = self._round_fn(window, steps, greedy)(
-            self.params, self._state, key)
+        # Active-slot compaction: the fused tail unembeds/samples only
+        # the armed slots, padded to the smallest compiled rung (padding
+        # indices == max_slots: gathers clamp, scatters drop). The
+        # materialized tail (mesh serving) always runs full-width.
+        B = self.cfg.max_slots
+        ba = self._ba_for(len(members)) if self._fused_tail else B
+        act = np.full((ba,), B, np.int32)
+        act[:len(members)] = sorted(members)
+        new_state, toks = self._round_fn(window, steps, greedy, ba)(
+            self.params, self._state, key, jnp.asarray(act))
         self._guard_live()  # reset() may have run while the round compiled
         self._state = new_state
+        if self._fused_tail:
+            # Documented as fused-tail occupancy (observability.md):
+            # materialized-tail runs leave both at 0 rather than
+            # masquerading as a full-occupancy fused engine.
+            self._bump("sampler_rows_sampled", ba * steps)
+            self._bump("sampler_rows_skipped", (B - ba) * steps)
         try:
             # Async host copy: the harvest worker's np.asarray then finds
             # the round's tokens already on the host instead of paying a

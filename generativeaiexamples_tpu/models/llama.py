@@ -217,7 +217,7 @@ def apply_decode_paged(params: Params, cfg: LlamaConfig, tokens: jax.Array,
                        block_table: jax.Array, kv_valid_len: jax.Array,
                        write_page: jax.Array, write_offset: jax.Array,
                        use_kernel: Optional[bool] = None,
-                       mesh=None,
+                       mesh=None, return_hidden: bool = False,
                        ) -> tuple[jax.Array, KVCache]:
     """Single-token decode step over the paged KV pool.
 
@@ -226,7 +226,10 @@ def apply_decode_paged(params: Params, cfg: LlamaConfig, tokens: jax.Array,
     every active sequence (so HBM reads scale with actual context, not cache
     capacity). write_page/write_offset: (B,) physical destination of this
     step's K/V (page 0 = trash for inactive slots). Returns
-    (logits (B, 1, V), updated cache).
+    (logits (B, 1, V), updated cache) — or (hidden (B, 1, D), cache)
+    under ``return_hidden`` (the engine's fused vocab-tiled sampling
+    tail does its own norm + streamed projection; see
+    ops/fused_sampler.py).
 
     Memory discipline: the layer scan only READS the pool; each layer's new
     K/V (tiny) is collected as a scan output and the pool is updated with
@@ -333,12 +336,13 @@ def apply_decode_paged(params: Params, cfg: LlamaConfig, tokens: jax.Array,
                 layer_k, (h, kv_cache["k"], kv_cache["v"],
                           kv_cache["ks"], kv_cache["vs"], li0),
                 params["layers"])
-            return unembed(params, cfg, h), {"k": pk, "v": pv,
-                                             "ks": ks, "vs": vs}
+            out = h if return_hidden else unembed(params, cfg, h)
+            return out, {"k": pk, "v": pv, "ks": ks, "vs": vs}
         (h, pk, pv, _), _ = jax.lax.scan(
             layer_k, (h, kv_cache["k"], kv_cache["v"], li0),
             params["layers"])
-        return unembed(params, cfg, h), {"k": pk, "v": pv}
+        return (h if return_hidden else unembed(params, cfg, h)), \
+            {"k": pk, "v": pv}
 
     def layer(h: jax.Array, xs):
         if quant:
@@ -395,7 +399,7 @@ def apply_decode_paged(params: Params, cfg: LlamaConfig, tokens: jax.Array,
     else:
         cache = {"k": write(kv_cache["k"], new_k),
                  "v": write(kv_cache["v"], new_v)}
-    return unembed(params, cfg, h), cache
+    return (h if return_hidden else unembed(params, cfg, h)), cache
 
 
 def _paged_prefix_attention(q, k_self, v_self, kc, vc, ksc, vsc,
@@ -737,17 +741,55 @@ def run_layers(layers: dict[str, jax.Array], cfg: LlamaConfig, h: jax.Array,
     return h
 
 
+def unembed_norm(params: Params, cfg: LlamaConfig, h: jax.Array
+                 ) -> jax.Array:
+    """The final-norm half of ``unembed`` — the fused vocab-tiled sampler
+    (ops/fused_sampler.py) applies it once and then streams the vocab
+    projection itself via ``lm_head_tile``."""
+    if cfg.norm == "layernorm1p":
+        return layernorm1p(h, params["final_norm"], params["final_norm_b"],
+                           cfg.rms_norm_eps)
+    return rmsnorm(h, params["final_norm"], cfg.rms_norm_eps)
+
+
+# lm_head QTensor leaves sliced along the vocab (output) axis; K-axis
+# leaves (pre_scale) pass through whole.
+_HEAD_VOCAB_LEAVES = ("q", "q4", "scale", "gscale", "gbias")
+
+
+def lm_head_tile(params: Params, cfg: LlamaConfig, hn: jax.Array,
+                 t0: jax.Array, tile: int) -> jax.Array:
+    """Project already-normed hidden states onto ONE vocab tile:
+    (B, D) x head[:, t0:t0+tile] -> (B, tile) f32.
+
+    Works for every lm_head storage the repo serves — tied embedding
+    (V, D), raw (D, V), and quantized dicts (int8/int4/grouped, whose
+    packing runs along the reduction axis, so an output-axis slice stays
+    a valid QTensor for ops.quant.matmul_f32). Inside a tile scan the
+    slice reads each weight byte exactly once per full vocab pass — the
+    same HBM traffic as one materialized unembed, with no (B, V) output."""
+    head = params.get("lm_head")
+    if head is None:
+        e = jax.lax.dynamic_slice_in_dim(params["embed"], t0, tile, axis=0)
+        return jax.lax.dot_general(
+            hn, e, (((hn.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    if isinstance(head, dict):
+        sliced = {k: (jax.lax.dynamic_slice_in_dim(v, t0, tile, axis=-1)
+                      if k in _HEAD_VOCAB_LEAVES else v)
+                  for k, v in head.items()}
+        return qmm_f32(hn, sliced)
+    return qmm_f32(hn, jax.lax.dynamic_slice_in_dim(head, t0, tile,
+                                                    axis=-1))
+
+
 def unembed(params: Params, cfg: LlamaConfig, h: jax.Array) -> jax.Array:
     """Final norm + output projection: (B, S, D) -> (B, S, V) float32.
 
     Operands stay compact (bf16/int8) with f32 MXU accumulation — casting
     to f32 first made XLA materialize an f32 copy of the whole vocab
     projection every decode step (ops/quant.py matmul_f32)."""
-    if cfg.norm == "layernorm1p":
-        h = layernorm1p(h, params["final_norm"], params["final_norm_b"],
-                        cfg.rms_norm_eps)
-    else:
-        h = rmsnorm(h, params["final_norm"], cfg.rms_norm_eps)
+    h = unembed_norm(params, cfg, h)
     head = params.get("lm_head")
     if head is None:
         return jax.lax.dot_general(

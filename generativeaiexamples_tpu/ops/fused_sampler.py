@@ -1,0 +1,261 @@
+"""Vocab-tiled fused unembed + sampling for the decode round.
+
+The former decode tail materialized, every step, a full ``(B, V)`` f32
+logit tensor, a second ``(B, V)`` penalized copy, and two ``(B, V)``
+bool masks — then ran a full vocab sort. ``PROFILE_r06.json`` attributes
+0.378 ms/step to that tail on a model whose matmul floor is 0.001 ms:
+on an HBM-bound decode step every one of those bytes is tokens/s lost.
+
+This module streams the ``lm_head`` in vocab tiles instead and folds the
+whole penalize→mask→sample chain into each tile, carrying only O(B·K)
+running state across tiles:
+
+- repetition penalty and bad-words masks are applied per tile, read from
+  uint32 *bitfield* masks (``ops/sampling.py pack_mask``: 1 bit per
+  token, sliced per tile — no (B, V) bool ever exists);
+- greedy is a running argmax;
+- sampling uses the Gumbel-max formulation (``argmax(scaled + gumbel)``
+  == categorical) with per-tile noise keyed by ``fold_in(key, tile)``,
+  plus a running top-``cand_k`` of raw scaled values (the Gumbel-top-k
+  carry) so top-k / top-p truncation can be resolved AFTER the stream
+  from the candidate set alone, with an exact running logsumexp for the
+  top-p mass. Full penalized logits never exist in any buffer.
+
+Exactness: greedy, pure temperature sampling (no truncation), and any
+top-k/top-p whose kept prefix fits in ``cand_k`` candidates are
+*sample-exact* against :func:`sample_reference_tiled` (the materialized
+penalize-then-sample oracle sharing the same per-tile noise layout) —
+pinned by tier-1 tests. A top-p set wider than ``cand_k`` tokens is
+truncated at ``cand_k`` (vLLM-style candidate cap; raise
+``SAMPLER_CAND_K`` to widen).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+from .sampling import MASK_BITS, NEG_INF, unpack_mask
+
+DEFAULT_TILE = 4096
+DEFAULT_CAND_K = 64
+
+
+def default_tile() -> int:
+    return int(os.environ.get("SAMPLER_TILE", str(DEFAULT_TILE)))
+
+
+def default_cand_k() -> int:
+    return int(os.environ.get("SAMPLER_CAND_K", str(DEFAULT_CAND_K)))
+
+
+def choose_tile(vocab_size: int, target: int | None = None) -> int:
+    """Largest divisor of ``vocab_size`` that is <= ``target`` and a
+    multiple of 32 (so each tile covers whole mask words and the word
+    slice is a contiguous dynamic_slice, not a gather). Falls back to a
+    single whole-vocab tile (tiny or 32-indivisible vocabs only — real
+    vocabs are 32-divisible and always admit a 32-aligned divisor)."""
+    target = max(1, min(target or default_tile(), vocab_size))
+    if vocab_size % MASK_BITS == 0:
+        for t in range(target - target % MASK_BITS, 0, -MASK_BITS):
+            if vocab_size % t == 0:
+                return t
+    return vocab_size
+
+
+def _slice_tile_mask(words: jax.Array, t0: jax.Array, tile: int,
+                     batch: int) -> jax.Array:
+    """Bool mask (B, tile) for tokens [t0, t0+tile) out of a (B, Wn) or
+    (Wn,) uint32 bitfield. Requires tile % 32 == 0 OR a single tile
+    covering the whole vocab (choose_tile guarantees one of the two)."""
+    if words.ndim == 1:
+        words = words[None, :]
+    if tile % MASK_BITS == 0:
+        w0 = t0 // MASK_BITS
+        ws = jax.lax.dynamic_slice_in_dim(words, w0, tile // MASK_BITS,
+                                          axis=1)
+        m = unpack_mask(ws, tile)
+    else:  # single whole-vocab tile (tiny/odd vocab fallback)
+        m = unpack_mask(words, tile)
+    return jnp.broadcast_to(m, (batch, tile))
+
+
+def _penalize_tile(logits, t0, tile, *, seen_words, banned_words, rep_pen,
+                   ban_tok=None, ban_hit=None):
+    """Fold repetition penalty + bad-words masks into one vocab tile.
+    ``logits``: (B, tile) f32 for tokens [t0, t0+tile). ``ban_tok`` /
+    ``ban_hit``: optional (B, S) sequence-ban tails (mask token
+    ban_tok[b, s] wherever ban_hit[b, s]) — the multi-token bad-words
+    rule, resolved per tile by an id compare instead of a vocab scatter."""
+    B = logits.shape[0]
+    lf = logits.astype(jnp.float32)
+    seen = _slice_tile_mask(seen_words, t0, tile, B)
+    pen = rep_pen[:, None]
+    lf = jnp.where(seen, jnp.where(lf > 0, lf / pen, lf * pen), lf)
+    banned = _slice_tile_mask(banned_words, t0, tile, B)
+    lf = jnp.where(banned, NEG_INF, lf)
+    if ban_tok is not None:
+        ids = t0 + jnp.arange(tile, dtype=jnp.int32)
+        hit = jnp.any((ids[None, :, None] == ban_tok[:, None, :])
+                      & ban_hit[:, None, :], axis=-1)
+        lf = jnp.where(hit, NEG_INF, lf)
+    return lf
+
+
+def fused_unembed_sample(tile_logits_fn, vocab_size: int, *, key, temp,
+                         top_k, top_p, rep_pen, seen_words, banned_words,
+                         ban_tok=None, ban_hit=None, greedy: bool = False,
+                         tile: int | None = None,
+                         cand_k: int | None = None) -> jax.Array:
+    """Stream the vocab in tiles and sample without materializing it.
+
+    tile_logits_fn(t0, tile) -> (B, tile) f32 raw logits for tokens
+    [t0, t0+tile) — typically a sliced lm_head projection
+    (models/llama.py ``lm_head_tile``). Returns (B,) int32 tokens with
+    the semantics of ``ops.sampling.sample`` applied to the penalized
+    logits (greedy when ``greedy`` — trace-time, the engine's all-greedy
+    round variant — no noise, no candidate carry, just a running argmax).
+    """
+    tile = choose_tile(vocab_size, tile)
+    cand_k = cand_k or default_cand_k()
+    n_tiles = vocab_size // tile
+    probe = jax.eval_shape(lambda: tile_logits_fn(jnp.int32(0), tile))
+    B = probe.shape[0]
+
+    def masked_tile(t):
+        t0 = (t * tile).astype(jnp.int32)
+        lf = _penalize_tile(
+            tile_logits_fn(t0, tile), t0, tile, seen_words=seen_words,
+            banned_words=banned_words, rep_pen=rep_pen,
+            ban_tok=ban_tok, ban_hit=ban_hit)
+        return t0, lf
+
+    if greedy:
+        def body(carry, t):
+            best, best_id = carry
+            t0, lf = masked_tile(t)
+            ids = t0 + jnp.arange(tile, dtype=jnp.int32)
+            tbest = jnp.max(lf, axis=-1)
+            tid = jnp.take(ids, jnp.argmax(lf, axis=-1))
+            better = tbest > best
+            return (jnp.where(better, tbest, best),
+                    jnp.where(better, tid, best_id)), None
+
+        init = (jnp.full((B,), -jnp.inf, jnp.float32),
+                jnp.zeros((B,), jnp.int32))
+        (_, best_id), _ = jax.lax.scan(
+            body, init, jnp.arange(n_tiles, dtype=jnp.int32))
+        return best_id
+
+    tf = jnp.maximum(temp, 1e-6)[:, None]
+
+    def body(carry, t):
+        cv, ci, cp, lse, bpert, bpid, braw, brid = carry
+        t0, lf = masked_tile(t)
+        ids = t0 + jnp.arange(tile, dtype=jnp.int32)
+        idb = jnp.broadcast_to(ids, lf.shape)
+        scaled = lf / tf
+        g = jax.random.gumbel(jax.random.fold_in(key, t),
+                              (B, tile), jnp.float32)
+        pert = scaled + g
+        # running logsumexp of the scaled logits (exact top-p mass)
+        lse = jnp.logaddexp(lse, jax.nn.logsumexp(scaled, axis=-1))
+        # running untruncated Gumbel-max (the pure-categorical case)
+        tb = jnp.max(pert, axis=-1)
+        ti = jnp.take_along_axis(idb, jnp.argmax(pert, -1)[:, None],
+                                 axis=1)[:, 0]
+        up = tb > bpert
+        bpert, bpid = jnp.where(up, tb, bpert), jnp.where(up, ti, bpid)
+        # running greedy argmax (temp<=0 / top_k==1 members of the batch)
+        rb = jnp.max(lf, axis=-1)
+        ri = jnp.take_along_axis(idb, jnp.argmax(lf, -1)[:, None],
+                                 axis=1)[:, 0]
+        ug = rb > braw
+        braw, brid = jnp.where(ug, rb, braw), jnp.where(ug, ri, brid)
+        # candidate merge: keep the top-cand_k raw scaled values seen so
+        # far, with their ids and Gumbel perturbations. Concatenating
+        # carry-first preserves ascending-id order among equal values —
+        # the same tie order as the oracle's stable argsort.
+        av = jnp.concatenate([cv, scaled], axis=-1)
+        ai = jnp.concatenate([ci, idb], axis=-1)
+        ap = jnp.concatenate([cp, pert], axis=-1)
+        cv, sel = jax.lax.top_k(av, cand_k)
+        ci = jnp.take_along_axis(ai, sel, axis=-1)
+        cp = jnp.take_along_axis(ap, sel, axis=-1)
+        return (cv, ci, cp, lse, bpert, bpid, braw, brid), None
+
+    init = (jnp.full((B, cand_k), -jnp.inf, jnp.float32),
+            jnp.zeros((B, cand_k), jnp.int32),
+            jnp.full((B, cand_k), -jnp.inf, jnp.float32),
+            jnp.full((B,), -jnp.inf, jnp.float32),
+            jnp.full((B,), -jnp.inf, jnp.float32),
+            jnp.zeros((B,), jnp.int32),
+            jnp.full((B,), -jnp.inf, jnp.float32),
+            jnp.zeros((B,), jnp.int32))
+    (cv, ci, cp, lse, _, bpid, _, brid), _ = jax.lax.scan(
+        body, init, jnp.arange(n_tiles, dtype=jnp.int32))
+
+    V = vocab_size
+    kk = jnp.where(top_k <= 0, V, top_k)
+    p = jnp.where((top_p <= 0) | (top_p >= 1.0), 1.0, top_p)
+    # kept set == a prefix of the value-sorted order (both truncations
+    # keep prefixes): token at sorted position j survives if j < k and
+    # the cumulative mass before it is < p — same rule as
+    # ops.sampling.sample, evaluated on the candidate prefix.
+    probs = jnp.exp(cv - lse[:, None])
+    cum_before = jnp.cumsum(probs, axis=-1) - probs
+    keep = ((jnp.arange(cand_k)[None, :] < kk[:, None])
+            & (cum_before < p[:, None]))
+    kept_pert = jnp.where(keep, cp, -jnp.inf)
+    trunc_tok = jnp.take_along_axis(
+        ci, jnp.argmax(kept_pert, -1)[:, None], axis=1)[:, 0]
+    untruncated = (kk >= V) & (p >= 1.0)
+    sampled = jnp.where(untruncated, bpid, trunc_tok)
+    is_greedy = (temp <= 0) | (top_k == 1)
+    return jnp.where(is_greedy, brid, sampled).astype(jnp.int32)
+
+
+def tiled_gumbel(key, batch: int, vocab_size: int, tile: int) -> jax.Array:
+    """The full (B, V) Gumbel field the fused sampler consumes tile by
+    tile — oracle/test use only (it materializes what the fused path
+    exists to avoid)."""
+    n_tiles = -(-vocab_size // tile)
+    parts = [jax.random.gumbel(jax.random.fold_in(key, t),
+                               (batch, tile), jnp.float32)
+             for t in range(n_tiles)]
+    return jnp.concatenate(parts, axis=-1)[:, :vocab_size]
+
+
+def sample_reference_tiled(logits, key, temp, top_k, top_p,
+                           tile: int) -> jax.Array:
+    """Materialized penalize-then-sample oracle with the fused sampler's
+    noise layout: full (B, V) logits, stable descending sort, top-k /
+    top-p prefix keep, argmax over kept Gumbel-perturbed values. The
+    fused path must produce IDENTICAL tokens for the same key whenever
+    the kept prefix fits in its candidate carry (tier-1 pinned)."""
+    B, V = logits.shape
+    lf = logits.astype(jnp.float32)
+    greedy_ids = jnp.argmax(lf, axis=-1).astype(jnp.int32)
+    scaled = lf / jnp.maximum(temp, 1e-6)[:, None]
+    sort_idx = jnp.argsort(-scaled, axis=-1)
+    ranks = jnp.zeros_like(sort_idx).at[
+        jnp.arange(B)[:, None], sort_idx
+    ].set(jnp.broadcast_to(jnp.arange(V), (B, V)))
+    k = jnp.where(top_k[:, None] <= 0, V, top_k[:, None])
+    keep = ranks < k
+    sorted_logits = jnp.take_along_axis(scaled, sort_idx, axis=-1)
+    sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(sorted_probs, axis=-1)
+    p = jnp.where((top_p[:, None] <= 0) | (top_p[:, None] >= 1.0),
+                  1.0, top_p[:, None])
+    sorted_keep_p = (cum - sorted_probs) < p
+    keep_p = jnp.zeros_like(keep).at[
+        jnp.arange(B)[:, None], sort_idx
+    ].set(sorted_keep_p)
+    pert = scaled + tiled_gumbel(key, B, V, tile)
+    masked = jnp.where(keep & keep_p, pert, -jnp.inf)
+    sampled = jnp.argmax(masked, axis=-1).astype(jnp.int32)
+    is_greedy = (temp <= 0) | (top_k == 1)
+    return jnp.where(is_greedy, greedy_ids, sampled)

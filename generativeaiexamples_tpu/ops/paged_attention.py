@@ -13,17 +13,34 @@ bottleneck and the round-2/3 OOMs in one:
 - pool reads inside an opaque kernel plus an external scatter defeat
   XLA's aliasing analysis, double-buffering the loop carry.
 
-This kernel does the whole step natively instead: one program per slot,
-the block table and write location ride scalar prefetch (SMEM), the page
-window streams HBM->VMEM through a manual double-buffered DMA pipeline,
-attention accumulates page-by-page with an online softmax (flash style)
-over a PER-SLOT dynamic page count (HBM reads follow each sequence's live
-length, not the batch max), and the new K/V row lands in the pool via an
-aligned 8-row-tile write whose preserved rows come from the already-
-streamed window page — no read-modify-write round trip. The pool is
-aliased in/out (``input_output_aliases``), so the whole decode step
-leaves the pool in place, in one layout, with zero XLA
-gathers/scatters/copies.
+This kernel does the whole step natively: the block table and write
+location ride scalar prefetch (SMEM), page windows stream HBM->VMEM
+through a manual multi-buffered DMA pipeline, attention accumulates
+page-by-page with an online softmax (flash style) over PER-SLOT dynamic
+page counts (HBM reads follow each sequence's live length, not the batch
+max), and the new K/V row lands in the pool via an aligned 8-row-tile
+write whose preserved rows come from the already-streamed window page —
+no read-modify-write round trip. The pool is aliased in/out
+(``input_output_aliases``), so the whole decode step leaves the pool in
+place, in one layout, with zero XLA gathers/scatters/copies.
+
+Program layout (round 8): programs are SLOT GROUPS, not single slots.
+The former one-program-per-slot grid ran B sequential programs per layer,
+and each program boundary drained its private 2-deep DMA pipeline — at 64
+slots the drains and fixed per-program overhead were most of the decay
+from 0.735 to 0.576 HBM-bandwidth utilization (BENCH_SWEEP_r05). Now one
+program owns ``_GROUP`` slots and streams ALL their live pages through a
+single flat (slot, page) loop behind one ``_NBUF``-deep buffer ring:
+
+- page fetches batch across slots — the fetch for the next slot's first
+  page issues while the current slot's last pages are still computing, so
+  a short or finished slot never leaves the stream idle;
+- per-slot online-softmax state lives in VMEM scratch, indexed by the
+  flat loop's current slot;
+- the pipeline depth (``_NBUF - 1`` fetches in flight) rides out
+  per-page DMA latency variance that double buffering could not;
+- program count (and per-program fixed overhead) drops by the group
+  factor.
 
 Same role as the paged-KV device kernels the reference gets from the
 TRT-LLM C++ backend (reference: ensemble_models/llama/tensorrt_llm/
@@ -32,11 +49,26 @@ config.pbtxt.j2:28-34 paged_kv_cache; model_server/server.py:67-71).
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 
 NEG = -1e30
-_TILE = 8  # sublane tile: HBM DMA slices must be 8-row aligned
+_TILE = 8   # sublane tile: HBM DMA slices must be 8-row aligned
+_NBUF = 4   # page-buffer ring depth: _NBUF - 1 fetches stay in flight
+_GROUP = 8  # slots per program (largest divisor of B <= this)
+
+
+def group_size(batch: int) -> int:
+    """Slots per kernel program: the largest divisor of ``batch`` that is
+    <= ``PAGED_GROUP_SLOTS`` (default 8). A divisor keeps the grid exact;
+    the env knob exists for VMEM-constrained geometries."""
+    cap = int(os.environ.get("PAGED_GROUP_SLOTS", str(_GROUP)))
+    g = max(1, min(batch, cap))
+    while batch % g:
+        g -= 1
+    return g
 
 
 def kernel_supported(page: int, num_heads: int, num_kv_heads: int,
@@ -94,7 +126,6 @@ def paged_attention_decode(q: jax.Array, pool_k: jax.Array,
 
     B, H, hd = q.shape
     L, N, KV, page, _ = pool_k.shape
-    W = block_table.shape[1]
     G = H // KV
     scale = hd ** -0.5
     quant = pool_ks is not None
@@ -103,137 +134,188 @@ def paged_attention_decode(q: jax.Array, pool_k: jax.Array,
             q, pool_k, pool_v, pool_ks, pool_vs, block_table, lengths,
             cur_k, cur_v, write_page, write_offset, layer,
             interpret=interpret)
+    Gs = group_size(B)
 
     def kernel(tbl_ref, len_ref, wp_ref, off_ref, l_ref, q_ref,
                k_hbm, v_hbm, ck_ref, cv_ref, out_ref, opk_ref, opv_ref,
-               kbuf, vbuf, krw, vrw, sem, rw_sem):
-        # One program per slot; the page window streams through a manual
-        # double-buffered DMA pipeline (a page-per-grid-step layout was
-        # measured ~4x slower: B*W*L tiny programs of fixed overhead
-        # swamped the 2 MB of useful work each). The loop trip count is
-        # the slot's OWN live page count, not the static table width — HBM
-        # traffic follows each sequence's actual length (a finished or
-        # short slot streams nothing), which is what makes throughput
-        # monotone in slot count instead of every slot paying the longest
-        # sequence's window.
-        b = pl.program_id(0)
+               kbuf, vbuf, accs, ms, ls, stk, stv, krw, vrw, sem, rw_sem):
+        gi = pl.program_id(0)
         li = l_ref[0]
-        length = len_ref[b]
-        n_pages = jax.lax.div(length + (page - 1), page)  # dynamic bound
+        b0 = gi * Gs
+        # Per-slot live page counts and their flat prefix starts: the
+        # group's pages stream as ONE flat sequence t in [0, total),
+        # slot boundaries invisible to the DMA pipeline.
+        counts = [jax.lax.div(len_ref[b0 + i] + (page - 1), page)
+                  for i in range(Gs)]
+        starts = [jnp.int32(0)]
+        for c in counts:
+            starts.append(starts[-1] + c)
+        total = starts[Gs]
 
-        def kdma(slot, w):
-            return pltpu.make_async_copy(k_hbm.at[li, tbl_ref[b, w]],
-                                         kbuf.at[slot], sem.at[slot, 0])
+        # Scratch persists across grid programs: re-init this group's
+        # softmax state (a zero-page slot must fold its current token
+        # against a fresh carry, not the previous group's).
+        for i in range(Gs):
+            accs[i] = jnp.zeros((KV, G, hd), jnp.float32)
+            ms[i] = jnp.full((KV, G), NEG, jnp.float32)
+            ls[i] = jnp.zeros((KV, G), jnp.float32)
 
-        def vdma(slot, w):
-            return pltpu.make_async_copy(v_hbm.at[li, tbl_ref[b, w]],
-                                         vbuf.at[slot], sem.at[slot, 1])
+        def locate(t):
+            """flat index -> (slot-in-group, page-within-slot, count)."""
+            sidx = jnp.int32(0)
+            base = jnp.int32(0)
+            for i in range(Gs - 1):
+                past = t >= starts[i + 1]
+                sidx = sidx + past.astype(jnp.int32)
+                base = base + jnp.where(past, counts[i], 0)
+            cnt = counts[Gs - 1]
+            for i in range(Gs - 1):
+                cnt = jnp.where(sidx == i, counts[i], cnt)
+            return sidx, t - base, cnt
 
-        @pl.when(n_pages > 0)
-        def _():
-            kdma(0, 0).start()
-            vdma(0, 0).start()
+        def dmas(sidx, w, slot):
+            pg = tbl_ref[b0 + sidx, w]
+            return (pltpu.make_async_copy(k_hbm.at[li, pg], kbuf.at[slot],
+                                          sem.at[slot, 0]),
+                    pltpu.make_async_copy(v_hbm.at[li, pg], vbuf.at[slot],
+                                          sem.at[slot, 1]))
 
-        wp = wp_ref[b]
-        qv = q_ref[0].reshape(KV, G, hd)
+        def start_fetch(t):
+            sidx, w, _ = locate(t)
+            for d in dmas(sidx, w, jax.lax.rem(t, _NBUF)):
+                d.start()
 
-        def body(w, carry):
-            acc, m, l = carry
-            slot = jax.lax.rem(w, 2)
-            nxt = jax.lax.rem(w + 1, 2)
+        # Prologue: fill the ring (up to _NBUF - 1 fetches in flight).
+        for j in range(_NBUF - 1):
+            @pl.when(jnp.int32(j) < total)
+            def _(j=j):
+                start_fetch(jnp.int32(j))
 
-            @pl.when(w + 1 < n_pages)
+        def body(t, carry):
+            # Top off the pipeline first: buffer (t-1) % _NBUF was freed
+            # by the previous step's (program-ordered) compute.
+            @pl.when(t + _NBUF - 1 < total)
             def _():
-                kdma(nxt, w + 1).start()
-                vdma(nxt, w + 1).start()
-
-            kdma(slot, w).wait()
-            vdma(slot, w).wait()
+                start_fetch(t + _NBUF - 1)
+            slot = jax.lax.rem(t, _NBUF)
+            # ONE locate per iteration: the wait descriptors reuse its
+            # result (the top-off fetch above locates t + _NBUF - 1, a
+            # different flat index).
+            sidx, w, cnt = locate(t)
+            b = b0 + sidx
+            for d in dmas(sidx, w, slot):
+                d.wait()
+            length = len_ref[b]
+            qv = q_ref[sidx].reshape(KV, G, hd)
             # Operands stay in pool dtype into the MXU; accumulation is
             # f32 via preferred_element_type — no widened VMEM copies.
-            kp = kbuf[slot]                                    # (KV,page,hd)
+            kp = kbuf[slot]                                  # (KV,page,hd)
             vp = vbuf[slot]
             scores = jax.lax.dot_general(
                 qv, kp, (((2,), (2,)), ((0,), (0,))),
-                preferred_element_type=jnp.float32) * scale    # (KV,G,page)
+                preferred_element_type=jnp.float32) * scale  # (KV,G,page)
             valid = (w * page + jax.lax.broadcasted_iota(
                 jnp.int32, (1, 1, page), 2)) < length
             scores = jnp.where(valid, scores, NEG)
 
+            m = ms[sidx][..., None]
+            l = ls[sidx][..., None]
             m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
             alpha = jnp.exp(m - m_new)
-            p = jnp.exp(scores - m_new)                        # (KV,G,page)
-            l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            p = jnp.exp(scores - m_new)                      # (KV,G,page)
+            ls[sidx] = (l * alpha + jnp.sum(p, axis=-1,
+                                            keepdims=True))[..., 0]
+            ms[sidx] = m_new[..., 0]
             pv = jax.lax.dot_general(
                 p.astype(vp.dtype), vp, (((2,), (1,)), ((0,), (0,))),
-                preferred_element_type=jnp.float32)            # (KV,G,hd)
-            return acc * alpha + pv, m_new, l_new
+                preferred_element_type=jnp.float32)          # (KV,G,hd)
+            accs[sidx] = accs[sidx] * alpha + pv
 
-        acc0 = jnp.zeros((KV, G, hd), jnp.float32)
-        m0 = jnp.full((KV, G, 1), NEG, jnp.float32)
-        l0 = jnp.zeros((KV, G, 1), jnp.float32)
-        acc, m, l = jax.lax.fori_loop(0, n_pages, body, (acc0, m0, l0))
+            # Stage the append-source tile at the slot's LAST page: the
+            # ring reuses this buffer _NBUF pages later (possibly mid-way
+            # through ANOTHER slot), so the 8 preserved rows are copied
+            # out now instead of read back from HBM in the epilogue.
+            off = off_ref[b]
+            tile0 = (off // _TILE) * _TILE
 
-        # Fold in the current token (not yet pooled) — exact via partials.
-        ck = ck_ref[0].astype(jnp.float32)                     # (KV,hd)
-        cv = cv_ref[0].astype(jnp.float32)
-        s_cur = jnp.sum(qv.astype(jnp.float32) * ck[:, None, :],
-                        axis=-1, keepdims=True) * scale        # (KV,G,1)
-        m2 = jnp.maximum(m, s_cur)
-        a = jnp.exp(m - m2)
-        bta = jnp.exp(s_cur - m2)
-        out = acc * a + cv[:, None, :] * bta
-        denom = l * a + bta
-        out_ref[0] = (out / denom).reshape(H, hd).astype(out_ref.dtype)
+            @pl.when(w + 1 == cnt)
+            def _():
+                stk[sidx] = kbuf[slot, :, pl.ds(tile0, _TILE), :]
+                stv[sidx] = vbuf[slot, :, pl.ds(tile0, _TILE), :]
+            return carry
 
-        # Append the new row WITHOUT a read-modify-write round trip to HBM:
-        # the rows that must be preserved (rows < off of the write page)
-        # are already in VMEM — when off > 0 the write page IS the last
-        # streamed window page (index n_pages-1). When off == 0 the page
-        # is fresh: rows > 0 hold garbage until the step that writes each
-        # row, and attention masks rows >= length, so garbage is never
-        # read. Only the aligned 8-row tile containing the new row is
-        # DMA'd back — 1/16th of a page instead of a full-page read+write.
-        off = off_ref[b]
-        tile0 = (off // _TILE) * _TILE
-        last = jnp.maximum(n_pages - 1, 0)
-        src_k = kbuf[jax.lax.rem(last, 2), :, pl.ds(tile0, _TILE), :]
-        src_v = vbuf[jax.lax.rem(last, 2), :, pl.ds(tile0, _TILE), :]
-        row_mask = jax.lax.broadcasted_iota(
-            jnp.int32, (1, _TILE, 1), 1) == (off - tile0)
-        krw[:] = jnp.where(row_mask, ck_ref[0][:, None, :], src_k)
-        vrw[:] = jnp.where(row_mask, cv_ref[0][:, None, :], src_v)
-        kwr = pltpu.make_async_copy(
-            krw, opk_ref.at[li, wp, :, pl.ds(tile0, _TILE)], rw_sem.at[0])
-        vwr = pltpu.make_async_copy(
-            vrw, opv_ref.at[li, wp, :, pl.ds(tile0, _TILE)], rw_sem.at[1])
-        kwr.start()
-        vwr.start()
-        kwr.wait()
-        vwr.wait()
+        jax.lax.fori_loop(0, total, body, jnp.int32(0))
+
+        # Per-slot epilogue: fold the current (not yet pooled) token in
+        # exactly via partials, then append the new row without a
+        # read-modify-write round trip — rows to preserve (rows < off of
+        # the write page) were staged from the streamed window; when
+        # off == 0 the page is fresh and dead rows are garbage attention
+        # masks (rows >= length are never read).
+        writes = []
+        for i in range(Gs):
+            b = b0 + i
+            qv = q_ref[i].reshape(KV, G, hd)
+            m = ms[i][..., None]
+            l = ls[i][..., None]
+            acc = accs[i]
+            ck = ck_ref[i].astype(jnp.float32)               # (KV,hd)
+            cv = cv_ref[i].astype(jnp.float32)
+            s_cur = jnp.sum(qv.astype(jnp.float32) * ck[:, None, :],
+                            axis=-1, keepdims=True) * scale  # (KV,G,1)
+            m2 = jnp.maximum(m, s_cur)
+            a = jnp.exp(m - m2)
+            bta = jnp.exp(s_cur - m2)
+            out = acc * a + cv[:, None, :] * bta
+            denom = l * a + bta
+            out_ref[i] = (out / denom).reshape(H, hd).astype(out_ref.dtype)
+
+            off = off_ref[b]
+            tile0 = (off // _TILE) * _TILE
+            row_mask = jax.lax.broadcasted_iota(
+                jnp.int32, (1, _TILE, 1), 1) == (off - tile0)
+            krw[i] = jnp.where(row_mask, ck_ref[i][:, None, :], stk[i])
+            vrw[i] = jnp.where(row_mask, cv_ref[i][:, None, :], stv[i])
+            wp = wp_ref[b]
+            kwr = pltpu.make_async_copy(
+                krw.at[i], opk_ref.at[li, wp, :, pl.ds(tile0, _TILE)],
+                rw_sem.at[i, 0])
+            vwr = pltpu.make_async_copy(
+                vrw.at[i], opv_ref.at[li, wp, :, pl.ds(tile0, _TILE)],
+                rw_sem.at[i, 1])
+            kwr.start()
+            vwr.start()
+            writes += [kwr, vwr]
+        for wcp in writes:
+            wcp.wait()
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=5,   # table, lengths, write page/offset, layer
-        grid=(B,),
+        grid=(B // Gs,),
         in_specs=[
-            pl.BlockSpec((1, H, hd), lambda b, *_: (b, 0, 0)),
+            pl.BlockSpec((Gs, H, hd), lambda g, *_: (g, 0, 0)),
             pl.BlockSpec(memory_space=pltpu.ANY),   # K pool stays in HBM
             pl.BlockSpec(memory_space=pltpu.ANY),   # V pool stays in HBM
-            pl.BlockSpec((1, KV, hd), lambda b, *_: (b, 0, 0)),
-            pl.BlockSpec((1, KV, hd), lambda b, *_: (b, 0, 0)),
+            pl.BlockSpec((Gs, KV, hd), lambda g, *_: (g, 0, 0)),
+            pl.BlockSpec((Gs, KV, hd), lambda g, *_: (g, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, H, hd), lambda b, *_: (b, 0, 0)),
+            pl.BlockSpec((Gs, H, hd), lambda g, *_: (g, 0, 0)),
             pl.BlockSpec(memory_space=pltpu.ANY),
             pl.BlockSpec(memory_space=pltpu.ANY),
         ],
         scratch_shapes=[
-            pltpu.VMEM((2, KV, page, hd), pool_k.dtype),
-            pltpu.VMEM((2, KV, page, hd), pool_v.dtype),
-            pltpu.VMEM((KV, _TILE, hd), pool_k.dtype),
-            pltpu.VMEM((KV, _TILE, hd), pool_v.dtype),
-            pltpu.SemaphoreType.DMA((2, 2)),
-            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.VMEM((_NBUF, KV, page, hd), pool_k.dtype),
+            pltpu.VMEM((_NBUF, KV, page, hd), pool_v.dtype),
+            pltpu.VMEM((Gs, KV, G, hd), jnp.float32),   # accs
+            pltpu.VMEM((Gs, KV, G), jnp.float32),       # ms
+            pltpu.VMEM((Gs, KV, G), jnp.float32),       # ls
+            pltpu.VMEM((Gs, KV, _TILE, hd), pool_k.dtype),  # staged k
+            pltpu.VMEM((Gs, KV, _TILE, hd), pool_v.dtype),  # staged v
+            pltpu.VMEM((Gs, KV, _TILE, hd), pool_k.dtype),  # k writeback
+            pltpu.VMEM((Gs, KV, _TILE, hd), pool_v.dtype),  # v writeback
+            pltpu.SemaphoreType.DMA((_NBUF, 2)),
+            pltpu.SemaphoreType.DMA((Gs, 2)),
         ],
     )
     return pl.pallas_call(
@@ -259,25 +341,27 @@ def _paged_attention_decode_quant(q, pool_k, pool_v, pool_ks, pool_vs,
                                   *, interpret=False):
     """int8-KV variant of the decode kernel (see paged_attention_decode).
 
-    Same program structure — one program per slot, double-buffered page
-    DMA, online softmax, in-kernel append — with int8 pool pages and a
-    bf16 per-row scale pool (``(L, N, KV, page)``) streamed alongside.
-    HBM page traffic: int8 K+V (half the bf16 bytes) + the scale blocks
-    (~1/128 of the int8 bytes each). The int8->compute-dtype widen
-    happens once per page in VMEM; the MXU dots stay in the query dtype.
-    K scales fold into the scores AFTER the QK^T dot (each K row scales
-    its column of scores); V scales fold INTO the probabilities before
-    the PV dot (each V row scales its contribution).
+    Same slot-grouped program structure — flat cross-slot page loop,
+    ``_NBUF``-deep buffer ring, per-slot softmax scratch, staged
+    appends — with int8 pool pages and a bf16 per-row scale pool
+    (``(L, N, KV, page)``) streamed alongside. HBM page traffic: int8
+    K+V (half the bf16 bytes) + the scale blocks (~1/128 of the int8
+    bytes each). The int8->compute-dtype widen happens once per page in
+    VMEM; the MXU dots stay in the query dtype. K scales fold into the
+    scores AFTER the QK^T dot (each K row scales its column of scores);
+    V scales fold INTO the probabilities before the PV dot (each V row
+    scales its contribution).
 
     The append quantizes the current row in-kernel (symmetric per-row,
     ops/kv_quant.py semantics: scale cast to bf16 before the divide) and
     writes the int8 8-row tile the same way as the bf16 kernel. The
     SCALE write is a full (KV, page) block instead of a tile: the page
     dim sits on lanes there (so score broadcasting needs no transpose),
-    and lane-dim slices can't DMA — but the block to preserve is already
-    in VMEM (the write page IS the last streamed window page when
-    off > 0; fresh-page rows are garbage that attention masks), so the
-    write-back costs one small extra DMA, not a read-modify-write.
+    and lane-dim slices can't DMA — but the block to preserve was staged
+    from the streamed window at the slot's last page (the write page IS
+    the last streamed window page when off > 0; fresh-page rows are
+    garbage that attention masks), so the write-back costs one small
+    extra DMA, not a read-modify-write.
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -287,58 +371,88 @@ def _paged_attention_decode_quant(q, pool_k, pool_v, pool_ks, pool_vs,
     G = H // KV
     scale = hd ** -0.5
     cd = q.dtype  # compute dtype for the MXU dots
+    Gs = group_size(B)
 
     def kernel(tbl_ref, len_ref, wp_ref, off_ref, l_ref, q_ref,
                k_hbm, v_hbm, ks_hbm, vs_hbm, ck_ref, cv_ref,
                out_ref, opk_ref, opv_ref, opks_ref, opvs_ref,
-               kbuf, vbuf, ksbuf, vsbuf, krw, vrw, ksrw, vsrw,
-               sem, rw_sem):
-        b = pl.program_id(0)
+               kbuf, vbuf, ksbuf, vsbuf, accs, ms, ls,
+               stk, stv, stks, stvs, krw, vrw, ksrw, vsrw, sem, rw_sem):
+        gi = pl.program_id(0)
         li = l_ref[0]
-        length = len_ref[b]
-        n_pages = jax.lax.div(length + (page - 1), page)
+        b0 = gi * Gs
+        counts = [jax.lax.div(len_ref[b0 + i] + (page - 1), page)
+                  for i in range(Gs)]
+        starts = [jnp.int32(0)]
+        for c in counts:
+            starts.append(starts[-1] + c)
+        total = starts[Gs]
 
-        def dma(slot, w, which):
-            hbm, buf = ((k_hbm, kbuf), (v_hbm, vbuf),
-                        (ks_hbm, ksbuf), (vs_hbm, vsbuf))[which]
-            return pltpu.make_async_copy(hbm.at[li, tbl_ref[b, w]],
-                                         buf.at[slot], sem.at[slot, which])
+        for i in range(Gs):
+            accs[i] = jnp.zeros((KV, G, hd), jnp.float32)
+            ms[i] = jnp.full((KV, G), NEG, jnp.float32)
+            ls[i] = jnp.zeros((KV, G), jnp.float32)
 
-        @pl.when(n_pages > 0)
-        def _():
-            for which in range(4):
-                dma(0, 0, which).start()
+        def locate(t):
+            sidx = jnp.int32(0)
+            base = jnp.int32(0)
+            for i in range(Gs - 1):
+                past = t >= starts[i + 1]
+                sidx = sidx + past.astype(jnp.int32)
+                base = base + jnp.where(past, counts[i], 0)
+            cnt = counts[Gs - 1]
+            for i in range(Gs - 1):
+                cnt = jnp.where(sidx == i, counts[i], cnt)
+            return sidx, t - base, cnt
 
-        wp = wp_ref[b]
-        qv = q_ref[0].reshape(KV, G, hd)
+        def dmas(sidx, w, slot):
+            pg = tbl_ref[b0 + sidx, w]
+            pairs = ((k_hbm, kbuf), (v_hbm, vbuf),
+                     (ks_hbm, ksbuf), (vs_hbm, vsbuf))
+            return [pltpu.make_async_copy(hbm.at[li, pg], buf.at[slot],
+                                          sem.at[slot, which])
+                    for which, (hbm, buf) in enumerate(pairs)]
 
-        def body(w, carry):
-            acc, m, l = carry
-            slot = jax.lax.rem(w, 2)
-            nxt = jax.lax.rem(w + 1, 2)
+        def start_fetch(t):
+            sidx, w, _ = locate(t)
+            for d in dmas(sidx, w, jax.lax.rem(t, _NBUF)):
+                d.start()
 
-            @pl.when(w + 1 < n_pages)
+        for j in range(_NBUF - 1):
+            @pl.when(jnp.int32(j) < total)
+            def _(j=j):
+                start_fetch(jnp.int32(j))
+
+        def body(t, carry):
+            @pl.when(t + _NBUF - 1 < total)
             def _():
-                for which in range(4):
-                    dma(nxt, w + 1, which).start()
-
-            for which in range(4):
-                dma(slot, w, which).wait()
-            kp = kbuf[slot].astype(cd)                         # (KV,page,hd)
+                start_fetch(t + _NBUF - 1)
+            slot = jax.lax.rem(t, _NBUF)
+            # ONE locate per iteration (the top-off above locates its
+            # own flat index); wait descriptors reuse the result.
+            sidx, w, cnt = locate(t)
+            b = b0 + sidx
+            for d in dmas(sidx, w, slot):
+                d.wait()
+            length = len_ref[b]
+            qv = q_ref[sidx].reshape(KV, G, hd)
+            kp = kbuf[slot].astype(cd)                       # (KV,page,hd)
             vp = vbuf[slot].astype(cd)
-            ks = ksbuf[slot].astype(jnp.float32)               # (KV,page)
+            ks = ksbuf[slot].astype(jnp.float32)             # (KV,page)
             vs = vsbuf[slot].astype(jnp.float32)
             scores = jax.lax.dot_general(
                 qv, kp, (((2,), (2,)), ((0,), (0,))),
-                preferred_element_type=jnp.float32)            # (KV,G,page)
+                preferred_element_type=jnp.float32)          # (KV,G,page)
             scores = scores * ks[:, None, :] * scale
             valid = (w * page + jax.lax.broadcasted_iota(
                 jnp.int32, (1, 1, page), 2)) < length
             scores = jnp.where(valid, scores, NEG)
 
+            m = ms[sidx][..., None]
+            l = ls[sidx][..., None]
             m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
             alpha = jnp.exp(m - m_new)
-            p = jnp.exp(scores - m_new)                        # (KV,G,page)
+            p = jnp.exp(scores - m_new)                      # (KV,G,page)
             # Zero masked probabilities AND scales explicitly before the
             # PV dot: p underflows to ~0 for masked lanes, but the scale
             # lanes beyond `length` hold whatever bytes the page carries
@@ -348,105 +462,130 @@ def _paged_attention_decode_quant(q, pool_k, pool_v, pool_ks, pool_vs,
             # sibling _paged_prefix_attention.
             p = jnp.where(valid, p, 0.0)
             vs = jnp.where(valid[0], vs, 0.0)
-            l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            ls[sidx] = (l * alpha + jnp.sum(p, axis=-1,
+                                            keepdims=True))[..., 0]
+            ms[sidx] = m_new[..., 0]
             pv = jax.lax.dot_general(
                 (p * vs[:, None, :]).astype(cd), vp,
                 (((2,), (1,)), ((0,), (0,))),
-                preferred_element_type=jnp.float32)            # (KV,G,hd)
-            return acc * alpha + pv, m_new, l_new
+                preferred_element_type=jnp.float32)          # (KV,G,hd)
+            accs[sidx] = accs[sidx] * alpha + pv
 
-        acc0 = jnp.zeros((KV, G, hd), jnp.float32)
-        m0 = jnp.full((KV, G, 1), NEG, jnp.float32)
-        l0 = jnp.zeros((KV, G, 1), jnp.float32)
-        acc, m, l = jax.lax.fori_loop(0, n_pages, body, (acc0, m0, l0))
+            off = off_ref[b]
+            tile0 = (off // _TILE) * _TILE
 
-        # Current token folds in exact (unquantized), as in the bf16 kernel.
-        ck = ck_ref[0].astype(jnp.float32)                     # (KV,hd)
-        cv = cv_ref[0].astype(jnp.float32)
-        s_cur = jnp.sum(qv.astype(jnp.float32) * ck[:, None, :],
-                        axis=-1, keepdims=True) * scale        # (KV,G,1)
-        m2 = jnp.maximum(m, s_cur)
-        a = jnp.exp(m - m2)
-        bta = jnp.exp(s_cur - m2)
-        out = acc * a + cv[:, None, :] * bta
-        denom = l * a + bta
-        out_ref[0] = (out / denom).reshape(H, hd).astype(out_ref.dtype)
+            @pl.when(w + 1 == cnt)
+            def _():
+                stk[sidx] = kbuf[slot, :, pl.ds(tile0, _TILE), :]
+                stv[sidx] = vbuf[slot, :, pl.ds(tile0, _TILE), :]
+                stks[sidx] = ksbuf[slot]
+                stvs[sidx] = vsbuf[slot]
+            return carry
 
-        # Append: quantize the new row per kv head. The SAME function the
-        # engine's insert/gather paths use (ops/kv_quant.py) runs inside
-        # the kernel body — plain jnp, and single-sourcing it keeps the
-        # appended rows bit-identical to bucket-inserted rows.
+        jax.lax.fori_loop(0, total, body, jnp.int32(0))
+
+        # Per-slot epilogue: exact current-token fold (unquantized), then
+        # the in-kernel quantized append. quantize_rows is the SAME
+        # function the engine's insert/gather paths use (ops/kv_quant.py)
+        # — plain jnp, and single-sourcing it keeps appended rows
+        # bit-identical to bucket-inserted rows.
         from .kv_quant import quantize_rows
-        k_int, k_s = quantize_rows(ck)          # (KV, hd) int8, (KV,) bf16
-        v_int, v_s = quantize_rows(cv)
-        off = off_ref[b]
-        tile0 = (off // _TILE) * _TILE
-        last = jnp.maximum(n_pages - 1, 0)
-        lslot = jax.lax.rem(last, 2)
-        src_k = kbuf[lslot, :, pl.ds(tile0, _TILE), :]
-        src_v = vbuf[lslot, :, pl.ds(tile0, _TILE), :]
-        row_mask = jax.lax.broadcasted_iota(
-            jnp.int32, (1, _TILE, 1), 1) == (off - tile0)
-        krw[:] = jnp.where(row_mask, k_int[:, None, :], src_k)
-        vrw[:] = jnp.where(row_mask, v_int[:, None, :], src_v)
-        # Scale block: lane `off` takes the new scale, every other lane
-        # keeps the streamed page's value (garbage on a fresh page — rows
-        # >= length are never attended). When NO page was streamed
-        # (n_pages == 0: a trash-page append for an inactive slot) the
-        # double buffer is uninitialized VMEM — fill the other lanes
-        # with zeros instead of copying a possible NaN bit pattern into
-        # the pool.
-        lane = jax.lax.broadcasted_iota(jnp.int32, (1, page), 1) == off
-        streamed = n_pages > 0
-        ksrw[:] = jnp.where(lane, k_s[:, None].astype(jnp.bfloat16),
-                            jnp.where(streamed, ksbuf[lslot], 0))
-        vsrw[:] = jnp.where(lane, v_s[:, None].astype(jnp.bfloat16),
-                            jnp.where(streamed, vsbuf[lslot], 0))
-        writes = [
-            pltpu.make_async_copy(
-                krw, opk_ref.at[li, wp, :, pl.ds(tile0, _TILE)],
-                rw_sem.at[0]),
-            pltpu.make_async_copy(
-                vrw, opv_ref.at[li, wp, :, pl.ds(tile0, _TILE)],
-                rw_sem.at[1]),
-            pltpu.make_async_copy(ksrw, opks_ref.at[li, wp], rw_sem.at[2]),
-            pltpu.make_async_copy(vsrw, opvs_ref.at[li, wp], rw_sem.at[3]),
-        ]
-        for wcp in writes:
-            wcp.start()
+        writes = []
+        for i in range(Gs):
+            b = b0 + i
+            qv = q_ref[i].reshape(KV, G, hd)
+            m = ms[i][..., None]
+            l = ls[i][..., None]
+            acc = accs[i]
+            ck = ck_ref[i].astype(jnp.float32)               # (KV,hd)
+            cv = cv_ref[i].astype(jnp.float32)
+            s_cur = jnp.sum(qv.astype(jnp.float32) * ck[:, None, :],
+                            axis=-1, keepdims=True) * scale  # (KV,G,1)
+            m2 = jnp.maximum(m, s_cur)
+            a = jnp.exp(m - m2)
+            bta = jnp.exp(s_cur - m2)
+            out = acc * a + cv[:, None, :] * bta
+            denom = l * a + bta
+            out_ref[i] = (out / denom).reshape(H, hd).astype(out_ref.dtype)
+
+            k_int, k_s = quantize_rows(ck)      # (KV, hd) int8, (KV,) bf16
+            v_int, v_s = quantize_rows(cv)
+            off = off_ref[b]
+            tile0 = (off // _TILE) * _TILE
+            row_mask = jax.lax.broadcasted_iota(
+                jnp.int32, (1, _TILE, 1), 1) == (off - tile0)
+            krw[i] = jnp.where(row_mask, k_int[:, None, :], stk[i])
+            vrw[i] = jnp.where(row_mask, v_int[:, None, :], stv[i])
+            # Scale block: lane `off` takes the new scale, every other
+            # lane keeps the streamed page's value (garbage on a fresh
+            # page — rows >= length are never attended). When NO page
+            # was streamed (a trash-page append for an inactive slot)
+            # the staging scratch is uninitialized VMEM — fill the other
+            # lanes with zeros instead of copying a possible NaN bit
+            # pattern into the pool.
+            lane = jax.lax.broadcasted_iota(
+                jnp.int32, (1, page), 1) == off
+            streamed = counts[i] > 0
+            ksrw[i] = jnp.where(lane, k_s[:, None].astype(jnp.bfloat16),
+                                jnp.where(streamed, stks[i], 0))
+            vsrw[i] = jnp.where(lane, v_s[:, None].astype(jnp.bfloat16),
+                                jnp.where(streamed, stvs[i], 0))
+            wp = wp_ref[b]
+            slot_writes = [
+                pltpu.make_async_copy(
+                    krw.at[i], opk_ref.at[li, wp, :, pl.ds(tile0, _TILE)],
+                    rw_sem.at[i, 0]),
+                pltpu.make_async_copy(
+                    vrw.at[i], opv_ref.at[li, wp, :, pl.ds(tile0, _TILE)],
+                    rw_sem.at[i, 1]),
+                pltpu.make_async_copy(ksrw.at[i], opks_ref.at[li, wp],
+                                      rw_sem.at[i, 2]),
+                pltpu.make_async_copy(vsrw.at[i], opvs_ref.at[li, wp],
+                                      rw_sem.at[i, 3]),
+            ]
+            for wcp in slot_writes:
+                wcp.start()
+            writes += slot_writes
         for wcp in writes:
             wcp.wait()
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=5,   # table, lengths, write page/offset, layer
-        grid=(B,),
+        grid=(B // Gs,),
         in_specs=[
-            pl.BlockSpec((1, H, hd), lambda b, *_: (b, 0, 0)),
+            pl.BlockSpec((Gs, H, hd), lambda g, *_: (g, 0, 0)),
             pl.BlockSpec(memory_space=pltpu.ANY),   # K pool (int8, HBM)
             pl.BlockSpec(memory_space=pltpu.ANY),   # V pool (int8, HBM)
             pl.BlockSpec(memory_space=pltpu.ANY),   # K scales (HBM)
             pl.BlockSpec(memory_space=pltpu.ANY),   # V scales (HBM)
-            pl.BlockSpec((1, KV, hd), lambda b, *_: (b, 0, 0)),
-            pl.BlockSpec((1, KV, hd), lambda b, *_: (b, 0, 0)),
+            pl.BlockSpec((Gs, KV, hd), lambda g, *_: (g, 0, 0)),
+            pl.BlockSpec((Gs, KV, hd), lambda g, *_: (g, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, H, hd), lambda b, *_: (b, 0, 0)),
+            pl.BlockSpec((Gs, H, hd), lambda g, *_: (g, 0, 0)),
             pl.BlockSpec(memory_space=pltpu.ANY),
             pl.BlockSpec(memory_space=pltpu.ANY),
             pl.BlockSpec(memory_space=pltpu.ANY),
             pl.BlockSpec(memory_space=pltpu.ANY),
         ],
         scratch_shapes=[
-            pltpu.VMEM((2, KV, page, hd), pool_k.dtype),
-            pltpu.VMEM((2, KV, page, hd), pool_v.dtype),
-            pltpu.VMEM((2, KV, page), pool_ks.dtype),
-            pltpu.VMEM((2, KV, page), pool_vs.dtype),
-            pltpu.VMEM((KV, _TILE, hd), pool_k.dtype),
-            pltpu.VMEM((KV, _TILE, hd), pool_v.dtype),
-            pltpu.VMEM((KV, page), pool_ks.dtype),
-            pltpu.VMEM((KV, page), pool_vs.dtype),
-            pltpu.SemaphoreType.DMA((2, 4)),
-            pltpu.SemaphoreType.DMA((4,)),
+            pltpu.VMEM((_NBUF, KV, page, hd), pool_k.dtype),
+            pltpu.VMEM((_NBUF, KV, page, hd), pool_v.dtype),
+            pltpu.VMEM((_NBUF, KV, page), pool_ks.dtype),
+            pltpu.VMEM((_NBUF, KV, page), pool_vs.dtype),
+            pltpu.VMEM((Gs, KV, G, hd), jnp.float32),   # accs
+            pltpu.VMEM((Gs, KV, G), jnp.float32),       # ms
+            pltpu.VMEM((Gs, KV, G), jnp.float32),       # ls
+            pltpu.VMEM((Gs, KV, _TILE, hd), pool_k.dtype),  # staged k
+            pltpu.VMEM((Gs, KV, _TILE, hd), pool_v.dtype),  # staged v
+            pltpu.VMEM((Gs, KV, page), pool_ks.dtype),      # staged ks
+            pltpu.VMEM((Gs, KV, page), pool_vs.dtype),      # staged vs
+            pltpu.VMEM((Gs, KV, _TILE, hd), pool_k.dtype),  # k writeback
+            pltpu.VMEM((Gs, KV, _TILE, hd), pool_v.dtype),  # v writeback
+            pltpu.VMEM((Gs, KV, page), pool_ks.dtype),      # ks writeback
+            pltpu.VMEM((Gs, KV, page), pool_vs.dtype),      # vs writeback
+            pltpu.SemaphoreType.DMA((_NBUF, 4)),
+            pltpu.SemaphoreType.DMA((Gs, 4)),
         ],
     )
     return pl.pallas_call(

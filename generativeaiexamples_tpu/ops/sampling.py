@@ -14,8 +14,67 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 NEG_INF = -1e30
+
+# Seen/banned vocab masks live packed: 32 tokens per uint32 word (bit i of
+# word w covers token w*32+i). A (B, V) bool mask is 1 byte per token in
+# HBM; the packed form is 1 bit — 8x less mask traffic every decode step,
+# and the fused sampler slices words per vocab tile instead of streaming
+# byte-bools for the whole vocabulary.
+MASK_BITS = 32
+
+
+def mask_words(vocab_size: int) -> int:
+    """uint32 words needed to cover ``vocab_size`` mask bits."""
+    return -(-vocab_size // MASK_BITS)
+
+
+def pack_mask(mask: jax.Array) -> jax.Array:
+    """(…, V) bool -> (…, ceil(V/32)) uint32 bitfield (bit i of word w =
+    token w*32+i). Tokens past V pad with 0 (never banned/seen)."""
+    V = mask.shape[-1]
+    Wn = mask_words(V)
+    pad = Wn * MASK_BITS - V
+    if pad:
+        mask = jnp.pad(mask, [(0, 0)] * (mask.ndim - 1) + [(0, pad)])
+    bits = mask.reshape(*mask.shape[:-1], Wn, MASK_BITS).astype(jnp.uint32)
+    weights = (jnp.uint32(1) << jnp.arange(MASK_BITS, dtype=jnp.uint32))
+    return (bits * weights).sum(-1).astype(jnp.uint32)
+
+
+def unpack_mask(words: jax.Array, vocab_size: int) -> jax.Array:
+    """(…, Wn) uint32 -> (…, vocab_size) bool. ``vocab_size`` may cover a
+    slice (e.g. one vocab tile's words with vocab_size = tile)."""
+    bits = (words[..., :, None]
+            >> jnp.arange(MASK_BITS, dtype=jnp.uint32)) & jnp.uint32(1)
+    flat = bits.reshape(*words.shape[:-1], -1)
+    return flat[..., :vocab_size].astype(bool)
+
+
+def pack_mask_np(mask: np.ndarray) -> np.ndarray:
+    """numpy twin of pack_mask for host-side mask rendering (the engine
+    builds bad-words/prefix-seen masks on the submitting thread)."""
+    V = int(mask.shape[-1])
+    Wn = mask_words(V)
+    padded = np.zeros(mask.shape[:-1] + (Wn * MASK_BITS,), bool)
+    padded[..., :V] = mask
+    bits = padded.reshape(*mask.shape[:-1], Wn, MASK_BITS)
+    weights = (np.uint32(1) << np.arange(MASK_BITS, dtype=np.uint32))
+    return (bits.astype(np.uint32) * weights).sum(-1).astype(np.uint32)
+
+
+def set_token_bits(words: jax.Array, tokens: jax.Array,
+                   on: jax.Array) -> jax.Array:
+    """Set each row's ``tokens[b]`` bit where ``on[b]`` (rows with
+    on=False are untouched). words: (B, Wn) uint32, tokens/on: (B,).
+    One word per row is touched, so a gather/modify/scatter is exact."""
+    rows = jnp.arange(words.shape[0])
+    wi = (tokens // MASK_BITS).astype(jnp.int32)
+    bit = (on.astype(jnp.uint32)
+           << (tokens % MASK_BITS).astype(jnp.uint32))
+    return words.at[rows, wi].set(words[rows, wi] | bit)
 
 
 def sample(logits: jax.Array, key: jax.Array, temperature: jax.Array,

@@ -40,6 +40,26 @@ def synthetic_result() -> dict:
         "harvest_wait_ms": 420.0, "harvest_rounds": 3,
         "first_readback_ms": 260.0, "first_readbacks": 2,
         "dispatch_depth_peak": 2})
+    capacity = {
+        "slots_sweep": [8, 16], "prompt_len": 512, "output_len": 64,
+        "requests_per_rung": 8, "kv_pool_tokens_per_slot": 768,
+        "rungs": [
+            {"slots": 8, "engine_p50_ttft_ms": 150.0,
+             "engine_p99_ttft_ms": 160.0,
+             "decode_tokens_per_sec": 494.0,
+             "tokens_per_sec_per_slot": 61.8,
+             "hbm_bw_achieved_gbps": 590.4, "hbm_bw_util": 0.72,
+             "decode_window_steady": True,
+             "sampler_rows_skipped_frac": 0.05},
+            {"slots": 16, "engine_p50_ttft_ms": 170.0,
+             "engine_p99_ttft_ms": 185.0,
+             "decode_tokens_per_sec": 900.0,
+             "tokens_per_sec_per_slot": 56.3,
+             "hbm_bw_achieved_gbps": 610.0, "hbm_bw_util": 0.74,
+             "decode_window_steady": True,
+             "sampler_rows_skipped_frac": 0.02},
+        ],
+    }
     return bench.assemble_result(
         kind="e2e_chat", model="llama-2-7b-chat", headline=178.0,
         engine_p50=140.0, engine_p99=150.0, tput=500.0,
@@ -48,7 +68,8 @@ def synthetic_result() -> dict:
         e2e_tps_p50=32.0, pipeline=pipeline, quant="int8", kv_quant=None,
         weights="random-init", prompt_len=512, out_len=64, slots=8,
         steps_per_round=16, kv_pool_pages=63, device="TPU v5 lite",
-        rtt_ms=100.8, n_devices=1, bench_seconds=100.0)
+        rtt_ms=100.8, n_devices=1, bench_seconds=100.0,
+        capacity=capacity)
 
 
 def test_assembled_result_matches_schema():
@@ -102,6 +123,16 @@ def test_wrong_type_fails_fast():
     result = synthetic_result()
     result["decode_tokens_per_sec"] = "494.1"
     with pytest.raises(BenchSchemaError, match="decode_tokens_per_sec"):
+        validate_result(result)
+
+
+def test_capacity_rung_rename_fails_fast():
+    """Element-wise rung validation: a rename inside one slot rung's
+    dict cannot hide behind the list type."""
+    result = synthetic_result()
+    rung = result["capacity"]["rungs"][1]
+    rung["tput"] = rung.pop("decode_tokens_per_sec")
+    with pytest.raises(BenchSchemaError, match=r"capacity.rungs\[1\]"):
         validate_result(result)
 
 

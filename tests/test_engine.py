@@ -741,3 +741,42 @@ def test_threaded_harvest_stress_no_orphans():
               if eng._prefix_cache is not None else 0)
     assert len(set(eng._free_pages)) == len(eng._free_pages)
     assert len(eng._free_pages) + cached == eng._n_pages - 1
+
+
+def test_sampler_occupancy_counters_partial_vs_full(engine):
+    """The fused tail's active-slot compaction: a single request on a
+    4-slot engine must only pay for ONE sampler row per step (rung 1),
+    with the other 3 rows counted as skipped — the proof the
+    unembed/sampling tail is sized to occupancy, not max_slots."""
+    assert engine._fused_tail
+    before = engine.stats
+    s = engine.submit(engine.tokenizer.encode("occupancy"),
+                      SamplingParams(max_tokens=10, top_k=1,
+                                     ignore_eos=True))
+    s.text()
+    after = engine.stats
+    sampled = after["sampler_rows_sampled"] - before["sampler_rows_sampled"]
+    skipped = after["sampler_rows_skipped"] - before["sampler_rows_skipped"]
+    assert sampled > 0
+    # one active slot on a 4-slot engine: every decode step samples 1
+    # row and skips exactly max_slots - 1 = 3
+    assert skipped == 3 * sampled
+
+
+def test_greedy_parity_fused_vs_materialized_tail(engine, monkeypatch):
+    """ENGINE_FUSED_SAMPLER=0 keeps the classic materialized
+    unembed+penalize+argmax tail (the mesh-serving/oracle path); greedy
+    tokens must be identical either way — the fused tile stream computes
+    the same logits, just never as one (B, V) buffer."""
+    prompt = engine.tokenizer.encode("fused parity probe")
+    sp = SamplingParams(max_tokens=12, top_k=1, ignore_eos=True)
+    want = engine.submit(prompt, sp)
+    want.text()
+
+    monkeypatch.setenv("ENGINE_FUSED_SAMPLER", "0")
+    oracle = Engine(engine.params, CFG, ByteTokenizer(), ENGINE_CFG)
+    with oracle:
+        assert not oracle._fused_tail
+        got = oracle.submit(prompt, sp)
+        got.text()
+    assert got.token_ids == want.token_ids

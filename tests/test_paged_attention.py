@@ -251,3 +251,53 @@ def test_kernel_gate_is_off_on_cpu():
     from generativeaiexamples_tpu.models.configs import LLAMA2_7B
     from generativeaiexamples_tpu.models.llama import use_paged_kernel
     assert not use_paged_kernel(LLAMA2_7B, 128)
+
+
+@pytest.mark.parametrize("quant", [False, True])
+@pytest.mark.parametrize("group", [8, 4])
+def test_slot_grouped_kernel_boundary_lengths(quant, group, monkeypatch):
+    """Round-8 slot-grouped program parity at the nasty boundaries: the
+    flat cross-slot page loop must locate slot/page exactly when slot
+    lengths sit at k*page ± 1, when a ZERO-length slot sits mid-group
+    (it contributes no pages — its neighbors' flat offsets shift), and
+    across group boundaries (B=16 -> 2 programs at group 8, 4 programs
+    at group 4). GQA G=2 throughout (H=8, KV=4); quant runs the int8-KV
+    variant against the dequantized oracle."""
+    from generativeaiexamples_tpu.ops.kv_quant import dequantize_rows
+    from generativeaiexamples_tpu.ops.paged_attention import group_size
+
+    monkeypatch.setenv("PAGED_GROUP_SLOTS", str(group))
+    assert group_size(16) == group
+    B, H, W = 16, 8, 3
+    lengths = [15, 16, 17, 0, 31, 32, 33, 1,     # k*page ± 1, zero, one
+               48, 0, 33, 16, 5, 47, 2, 32]      # full window, mid zeros
+    q, pk, pv, table, lens, ck, cv = _setup(B, H, W, lengths, seed=11)
+    wp = jnp.zeros((B,), jnp.int32)              # trash writes: reads clean
+    off = lens % page
+    layer = jnp.zeros((1,), jnp.int32)
+    if quant:
+        kq, vq, ks, vs = _quantize_pools(pk, pv)
+        ref = paged_attention_decode_reference(
+            q, dequantize_rows(kq, ks, jnp.float32)[0],
+            dequantize_rows(vq, vs, jnp.float32)[0], table, lens, ck, cv)
+        out, *_ = paged_attention_decode(q, kq, vq, table, lens, ck, cv,
+                                         wp, off, layer, pool_ks=ks,
+                                         pool_vs=vs, interpret=True)
+    else:
+        ref = paged_attention_decode_reference(q, pk[0], pv[0], table,
+                                               lens, ck, cv)
+        out, *_ = paged_attention_decode(q, pk, pv, table, lens, ck, cv,
+                                         wp, off, layer, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_group_size_divisor_contract():
+    """Programs are exact divisors of the batch: the largest divisor
+    <= the cap, never a remainder group."""
+    from generativeaiexamples_tpu.ops.paged_attention import group_size
+    assert group_size(64) == 8
+    assert group_size(16) == 8
+    assert group_size(12) == 6    # 12 % 8 != 0 -> fall to 6
+    assert group_size(7) == 7     # prime <= cap: whole batch, one program
+    assert group_size(1) == 1

@@ -1,7 +1,12 @@
 """Smoke for tools/profile_decode.py --json: the roofline-attribution
 artifact (PROFILE_rNN.json round record) must be written with a stable
 key set, on any backend — the driver diffs these fields round over
-round, so a rename here is as breaking as a bench-field rename."""
+round, so a rename here is as breaking as a bench-field rename.
+
+Two artifact shapes are pinned: the classic single-rung attribution and
+the ``--slots A,B,...`` sweep (one attribution entry per slot rung plus
+per-rung achieved-bandwidth fraction — the BENCH_SWEEP ladder as one
+command)."""
 
 import json
 import os
@@ -11,26 +16,44 @@ sys.path.insert(0, os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
 
 
-REQUIRED_KEYS = {
+SHARED_KEYS = {
     "tool", "model", "device", "platform", "quant", "kv_quant",
-    "slots", "window_pages", "live_pages", "steps_per_round", "page_size",
-    "param_gb", "kv_live_bytes",
-    "full_ms_per_step", "no_unembed_ms_per_step", "window1_ms_per_step",
-    "unembed_ms_per_step", "window_stream_ms_per_step",
-    "matmul_floor_ms_per_step", "tokens_per_sec",
+    "steps_per_round", "page_size", "param_gb",
+    "matmul_floor_ms_per_step",
     # step-cost model inputs for the token-budget scheduler
     "prefill_bucket_tokens", "prefill_ms_per_token",
 }
 
+RUNG_KEYS = {
+    "slots", "window_pages", "live_pages", "kv_live_bytes",
+    "full_ms_per_step", "no_unembed_ms_per_step", "window1_ms_per_step",
+    "unembed_ms_per_step", "window_stream_ms_per_step", "tokens_per_sec",
+    # roofline: must-move bytes over measured step time vs chip peak
+    "achieved_bw_gbps", "achieved_bw_fraction",
+}
 
-def test_profile_decode_json_artifact(tmp_path, monkeypatch):
-    import profile_decode
+REQUIRED_KEYS = SHARED_KEYS | RUNG_KEYS
 
+# Sweep shape: shared keys + the rung list + the StepCostModel mirror
+# keys (engine/scheduler.py reads full_ms_per_step/slots/
+# prefill_ms_per_token at TOP level, so a sweep artifact committed as
+# the newest PROFILE_rNN still feeds the scheduler's cost model).
+SWEEP_KEYS = SHARED_KEYS | {"slots_sweep", "rungs", "slots",
+                            "full_ms_per_step"}
+
+
+def _setenv(monkeypatch):
     monkeypatch.setenv("PROF_MODEL", "llama-tiny")
     monkeypatch.setenv("PROF_QUANT", "none")
     monkeypatch.setenv("PROF_SLOTS", "2")
     monkeypatch.setenv("PROF_WINDOW", "2")
     monkeypatch.setenv("PROF_STEPS", "4")
+
+
+def test_profile_decode_json_artifact(tmp_path, monkeypatch):
+    import profile_decode
+
+    _setenv(monkeypatch)
     path = str(tmp_path / "PROFILE_test.json")
     artifact = profile_decode.main(json_path=path)
     assert os.path.exists(path)
@@ -40,19 +63,57 @@ def test_profile_decode_json_artifact(tmp_path, monkeypatch):
     assert set(on_disk) == REQUIRED_KEYS
     assert on_disk["tool"] == "profile_decode"
     assert on_disk["full_ms_per_step"] > 0
+    assert 0 <= on_disk["achieved_bw_fraction"] <= 1.5
     # attribution decomposes the full round: ablations can't be slower
-    # than the full program by more than noise
-    assert on_disk["unembed_ms_per_step"] > -1.0
-    assert on_disk["window_stream_ms_per_step"] > -1.0
+    # than the full program by more than noise (CPU timing is jittery;
+    # the bound only catches sign/unit bugs)
+    assert on_disk["unembed_ms_per_step"] > -10.0
+    assert on_disk["window_stream_ms_per_step"] > -10.0
+
+
+def test_profile_decode_slots_sweep_artifact(tmp_path, monkeypatch):
+    """--slots A,B writes ONE artifact with per-rung attribution +
+    achieved-bandwidth fraction, and mirrors the first rung's cost-model
+    keys at top level (StepCostModel.from_profile contract)."""
+    import profile_decode
+
+    from generativeaiexamples_tpu.engine.scheduler import StepCostModel
+
+    _setenv(monkeypatch)
+    path = str(tmp_path / "PROFILE_sweep.json")
+    artifact = profile_decode.main(json_path=path, slots_arg="1,2")
+    with open(path) as f:
+        on_disk = json.load(f)
+    assert on_disk == artifact
+    assert set(on_disk) == SWEEP_KEYS
+    assert on_disk["slots_sweep"] == [1, 2]
+    assert [r["slots"] for r in on_disk["rungs"]] == [1, 2]
+    for rung in on_disk["rungs"]:
+        assert set(rung) == RUNG_KEYS
+        assert rung["full_ms_per_step"] > 0
+        assert 0 <= rung["achieved_bw_fraction"] <= 1.5
+    # top-level mirror == first rung (the scheduler's cost model reads
+    # these without knowing about sweeps)
+    assert on_disk["slots"] == on_disk["rungs"][0]["slots"]
+    assert (on_disk["full_ms_per_step"]
+            == on_disk["rungs"][0]["full_ms_per_step"])
+    model = StepCostModel.from_profile(on_disk, source=path)
+    assert model.decode_step_ms == on_disk["full_ms_per_step"]
 
 
 def test_committed_round_artifact_is_valid():
     """The committed PROFILE_rNN.json next to BENCH parses and carries
-    the same contract (whatever round number is current)."""
+    the current contract, whichever shape (single-rung or sweep) the
+    round used."""
     import glob
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     artifacts = sorted(glob.glob(os.path.join(root, "PROFILE_r*.json")))
     assert artifacts, "no committed PROFILE_rNN.json round artifact"
     with open(artifacts[-1]) as f:
         obj = json.load(f)
-    assert set(obj) == REQUIRED_KEYS
+    if "slots_sweep" in obj:
+        assert set(obj) == SWEEP_KEYS
+        for rung in obj["rungs"]:
+            assert set(rung) == RUNG_KEYS
+    else:
+        assert set(obj) == REQUIRED_KEYS

@@ -76,7 +76,7 @@ def validate_result(result: dict, schema: dict | None = None) -> None:
     errors: list[str] = []
     _check_types("result", result, schema["top_level"], errors)
     for section in ("engine_pipeline", "e2e_ttft_dist_ms", "chat",
-                    "openloop", "fleet"):
+                    "openloop", "fleet", "capacity"):
         sub = result.get(section)
         if isinstance(sub, dict):
             _check_types(section, sub, schema[section], errors)
@@ -108,6 +108,20 @@ def validate_result(result: dict, schema: dict | None = None) -> None:
                 else:
                     errors.append(
                         f"fleet.policies[{i}]: {entry!r} is not an object")
+    # Capacity sweep: each slot rung carries the TTFT/throughput/HBM-
+    # roofline headline fields — validated element-wise so a rename in
+    # one rung's dict can't hide behind the list type.
+    capacity = result.get("capacity")
+    if isinstance(capacity, dict):
+        rungs = capacity.get("rungs")
+        if isinstance(rungs, list):
+            for i, entry in enumerate(rungs):
+                if isinstance(entry, dict):
+                    _check_types(f"capacity.rungs[{i}]", entry,
+                                 schema["capacity_rung"], errors)
+                else:
+                    errors.append(
+                        f"capacity.rungs[{i}]: {entry!r} is not an object")
     breakdown = result.get("e2e_breakdown_ms")
     if isinstance(breakdown, dict):
         allowed = set(schema["breakdown_stages"])

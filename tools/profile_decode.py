@@ -10,6 +10,12 @@ KV window stream / weight-stream floor, ms per step) as a machine-
 readable artifact — committed each round as ``PROFILE_rNN.json`` next
 to BENCH so perf attribution is driver-verifiable rather than narrated
 (VERDICT r5 "Next round" #8).
+
+``--slots 8,16,32,64`` switches to SWEEP mode: the same attribution is
+measured at every slot rung (shared params, per-rung pool) and the
+artifact carries one entry per rung plus each rung's achieved-HBM-
+bandwidth fraction — the 8→64 utilization decay of BENCH_SWEEP_r05 as
+one reproducible command instead of N hand-rolled runs.
 """
 
 from __future__ import annotations
@@ -26,40 +32,25 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from generativeaiexamples_tpu.utils.hbm import peak_bw as _peak_bw
 
-def main(json_path: str = ""):
+
+def profile_rung(params, cfg, *, slots: int, window: int, live_pages: int,
+                 steps: int, page: int, dtype, kv_quant: bool,
+                 param_bytes: int, use_kernel: bool) -> dict:
+    """Measure one slot-count rung: the full decode round and its
+    ablations (no-unembed, window=1), per step. Returns the per-rung
+    attribution dict the sweep artifact collects."""
     from generativeaiexamples_tpu.models import llama
-    from generativeaiexamples_tpu.models.configs import get_model_config
-    from generativeaiexamples_tpu.ops.quant import quantize_params
 
-    model = os.environ.get("PROF_MODEL", "llama-2-7b-chat")
-    B = int(os.environ.get("PROF_SLOTS", "8"))
-    W = int(os.environ.get("PROF_WINDOW", "8"))
-    K = int(os.environ.get("PROF_STEPS", "16"))
-    live_pages = int(os.environ.get("PROF_LIVE_PAGES", str(W)))
-    page = 128
-    cfg = get_model_config(model)
-    dt = jnp.bfloat16
-    quant = os.environ.get("PROF_QUANT", "int8")
-
-    def make(k):
-        p = llama.init_params(cfg, k, dtype=dt)
-        return quantize_params(p, quant) if quant != "none" else p
-    params = jax.jit(make)(jax.random.key(0))
-    jax.block_until_ready(params)
-    param_bytes = sum(x.nbytes for x in jax.tree.leaves(params))
-    print(f"params: {param_bytes/1e9:.2f} GB  slots={B} window={W} "
-          f"live={live_pages} steps={K}")
-
+    B, W, K = slots, window, steps
     n_pages = B * W + 1
-    kv_quant = os.environ.get("PROF_KV_QUANT", "") == "int8"
-    cache = llama.init_paged_kv_cache(cfg, n_pages, page, dt,
+    cache = llama.init_paged_kv_cache(cfg, n_pages, page, dtype,
                                       quantized=kv_quant)
     table = jnp.asarray(
         np.arange(1, 1 + B * W, dtype=np.int32).reshape(B, W))
     pos0 = jnp.full((B,), live_pages * page - K - 2, jnp.int32)
     tokens0 = jnp.ones((B,), jnp.int32)
-    use_kernel = jax.default_backend() == "tpu"
 
     def make_round(ablate=None):
         def round_fn(params, cache, tok, pos):
@@ -85,9 +76,10 @@ def main(json_path: str = ""):
             return cache, tok, pos, toks
         return jax.jit(round_fn, donate_argnums=(1,))
 
+    state = {"cache": cache}
+
     def run(label, f, extra_bytes=0):
-        nonlocal cache
-        c, tok, pos = cache, tokens0, pos0
+        c, tok, pos = state["cache"], tokens0, pos0
         for _ in range(2):
             c, tok, pos, toks = f(params, c, tok, pos0)
         jax.block_until_ready(toks)
@@ -97,10 +89,10 @@ def main(json_path: str = ""):
             c, tok, pos, toks = f(params, c, tok, pos0)
         jax.block_until_ready((c, toks))
         ms = (time.perf_counter() - t0) / n / K * 1e3
-        cache = c
+        state["cache"] = c
         bw = (param_bytes + extra_bytes) / ms * 1e3 / 1e9
-        print(f"{label}: {ms:.2f} ms/step ({bw:.0f} GB/s apparent, "
-              f"{B/ms*1e3:.0f} tok/s)")
+        print(f"[{B:>3} slots] {label}: {ms:.2f} ms/step "
+              f"({bw:.0f} GB/s apparent, {B/ms*1e3:.0f} tok/s)")
         return ms
 
     # bytes per cached token: int8 rows + bf16 scales under PROF_KV_QUANT
@@ -111,10 +103,71 @@ def main(json_path: str = ""):
     nou = run("no unembed   ", make_round("no_unembed"), kv_live)
     w1 = run("window=1     ", make_round("window1"),
              kv_live // max(live_pages, 1))
-    floor = param_bytes / 819e9 * 1e3
-    print(f"=> unembed+argmax ~{full-nou:.2f} ms/step, "
-          f"window stream ~{full-w1:.2f} ms/step, "
-          f"matmul floor {floor:.2f} ms/step @819GB/s")
+    peak = _peak_bw(jax.local_devices()[0])
+    achieved = (param_bytes + kv_live) / full * 1e3  # bytes/s
+    del state["cache"]  # free this rung's pool before the next builds
+    return {
+        "slots": B,
+        "window_pages": W,
+        "live_pages": live_pages,
+        "kv_live_bytes": kv_live,
+        "full_ms_per_step": round(full, 3),
+        "no_unembed_ms_per_step": round(nou, 3),
+        "window1_ms_per_step": round(w1, 3),
+        "unembed_ms_per_step": round(full - nou, 3),
+        "window_stream_ms_per_step": round(full - w1, 3),
+        "tokens_per_sec": round(B / full * 1e3, 1),
+        # Roofline: bytes the step MUST move (weights once + live KV
+        # window) over measured step time, as a fraction of the chip's
+        # peak — the ladder whose 8→64 decay this round exists to close.
+        "achieved_bw_gbps": round(achieved / 1e9, 1),
+        "achieved_bw_fraction": round(achieved / peak, 3),
+    }
+
+
+def main(json_path: str = "", slots_arg: str = ""):
+    from generativeaiexamples_tpu.models import llama
+    from generativeaiexamples_tpu.models.configs import get_model_config
+    from generativeaiexamples_tpu.ops.quant import quantize_params
+
+    model = os.environ.get("PROF_MODEL", "llama-2-7b-chat")
+    B = int(os.environ.get("PROF_SLOTS", "8"))
+    W = int(os.environ.get("PROF_WINDOW", "8"))
+    K = int(os.environ.get("PROF_STEPS", "16"))
+    live_pages = int(os.environ.get("PROF_LIVE_PAGES", str(W)))
+    page = 128
+    cfg = get_model_config(model)
+    dt = jnp.bfloat16
+    quant = os.environ.get("PROF_QUANT", "int8")
+    slots_arg = slots_arg or os.environ.get("PROF_SLOTS_SWEEP", "")
+    sweep = [int(s) for s in slots_arg.split(",") if s] if slots_arg \
+        else []
+
+    def make(k):
+        p = llama.init_params(cfg, k, dtype=dt)
+        return quantize_params(p, quant) if quant != "none" else p
+    params = jax.jit(make)(jax.random.key(0))
+    jax.block_until_ready(params)
+    param_bytes = sum(x.nbytes for x in jax.tree.leaves(params))
+    print(f"params: {param_bytes/1e9:.2f} GB  "
+          f"slots={sweep or B} window={W} live={live_pages} steps={K}")
+
+    kv_quant = os.environ.get("PROF_KV_QUANT", "") == "int8"
+    use_kernel = jax.default_backend() == "tpu"
+    floor = param_bytes / _peak_bw(jax.local_devices()[0]) * 1e3
+
+    rungs = [profile_rung(
+        params, cfg, slots=s, window=W, live_pages=live_pages, steps=K,
+        page=page, dtype=dt, kv_quant=kv_quant, param_bytes=param_bytes,
+        use_kernel=use_kernel) for s in (sweep or [B])]
+    r0 = rungs[0]
+    print(f"=> unembed+argmax ~{r0['unembed_ms_per_step']:.2f} ms/step, "
+          f"window stream ~{r0['window_stream_ms_per_step']:.2f} ms/step, "
+          f"matmul floor {floor:.2f} ms/step @peak")
+    if sweep:
+        ladder = " -> ".join(f"{r['slots']}:{r['achieved_bw_fraction']}"
+                             for r in rungs)
+        print(f"=> bandwidth ladder (fraction of peak): {ladder}")
 
     # Prefill token cost: one bucket-shaped forward (the engine's
     # admission program minus insert), timed per token. This is the
@@ -148,30 +201,37 @@ def main(json_path: str = ""):
         # Roofline attribution as a committed round artifact: the same
         # shape every round, so the driver diffs attribution (did the
         # window stream shrink? did unembed grow?) not just the headline.
-        artifact = {
+        shared = {
             "tool": "profile_decode",
             "model": model,
             "device": str(jax.local_devices()[0].device_kind),
             "platform": jax.default_backend(),
             "quant": quant,
             "kv_quant": "int8" if kv_quant else "",
-            "slots": B, "window_pages": W, "live_pages": live_pages,
             "steps_per_round": K, "page_size": page,
             "param_gb": round(param_bytes / 1e9, 3),
-            "kv_live_bytes": kv_live,
-            "full_ms_per_step": round(full, 3),
-            "no_unembed_ms_per_step": round(nou, 3),
-            "window1_ms_per_step": round(w1, 3),
-            "unembed_ms_per_step": round(full - nou, 3),
-            "window_stream_ms_per_step": round(full - w1, 3),
             "matmul_floor_ms_per_step": round(floor, 3),
-            "tokens_per_sec": round(B / full * 1e3, 1),
             # Step-cost model inputs for the token-budget scheduler
             # (engine/scheduler.py): prefill cost per prompt token at
             # the measured bucket.
             "prefill_bucket_tokens": S,
             "prefill_ms_per_token": round(prefill_ms_tok, 4),
         }
+        if sweep:
+            # Sweep shape: one attribution entry per slot rung. The
+            # single-rung keys the scheduler's StepCostModel reads
+            # (full_ms_per_step, prefill_ms_per_token) are mirrored at
+            # top level from the FIRST rung so an _rNN sweep artifact
+            # still feeds the cost model unchanged.
+            artifact = dict(
+                shared,
+                slots_sweep=sweep,
+                slots=r0["slots"],
+                full_ms_per_step=r0["full_ms_per_step"],
+                rungs=rungs,
+            )
+        else:
+            artifact = dict(shared, **r0)
         with open(json_path, "w") as f:
             json.dump(artifact, f, indent=2)
             f.write("\n")
@@ -184,4 +244,10 @@ if __name__ == "__main__":
     ap.add_argument("--json", default="", metavar="PATH",
                     help="also write the roofline attribution as a JSON "
                          "artifact (PROFILE_rNN.json round record)")
-    main(json_path=ap.parse_args().json)
+    ap.add_argument("--slots", default="", metavar="A,B,C",
+                    help="sweep mode: comma-separated slot rungs "
+                         "(e.g. 8,16,32,64) measured with shared params; "
+                         "the artifact carries per-rung attribution + "
+                         "achieved-bandwidth fraction")
+    args = ap.parse_args()
+    main(json_path=args.json, slots_arg=args.slots)
