@@ -167,7 +167,12 @@ def build_engine(model_name: str, slots: int, prompt_len: int, out_len: int,
         max_prefill_bucket=bucket_cap if max_in > bucket_cap else None,
         kv_quant=os.environ.get("BENCH_KV_QUANT", ""),
         steps_per_round=int(os.environ.get("BENCH_STEPS_PER_ROUND", "16")),
-        dispatch_depth=int(os.environ.get("BENCH_DISPATCH_DEPTH", "2")))
+        dispatch_depth=int(os.environ.get("BENCH_DISPATCH_DEPTH", "2")),
+        # BENCH_SPEC=1: speculative decoding (prompt-lookup drafting +
+        # batched verification, engine/spec_decode.py). The chat and
+        # open-loop scenarios then grow a ``spec`` block with the run's
+        # acceptance rate and tokens-per-step multiplier.
+        spec_decode=os.environ.get("BENCH_SPEC", "") not in ("", "0"))
     engine = Engine(params, cfg, tokenizer, ecfg)
     # Allocate-and-verify: exercises worst-case transients and shrinks
     # the pool on OOM — free-HBM *estimates* on tunneled devices are
@@ -256,6 +261,34 @@ def run_engine_bench(engine, prompt_len: int, out_len: int, n_requests: int,
     return p50, p99, tput, time.monotonic() - t0
 
 
+def spec_snapshot(before: dict, after: dict):
+    """Speculative-decoding delta between two engine.stats snapshots:
+    the scenario's drafted/accepted counts, acceptance rate, and the
+    tokens-per-model-step multiplier over its verify rounds. None when
+    the window saw no verify round (spec off, or nothing draftable) —
+    scenarios publish ``spec: null`` rather than a block of zeros."""
+    rounds = int(after.get("spec_verify_rounds", 0)
+                 - before.get("spec_verify_rounds", 0))
+    if rounds <= 0:
+        return None
+    drafted = int(after.get("spec_draft_tokens", 0)
+                  - before.get("spec_draft_tokens", 0))
+    accepted = int(after.get("spec_accepted_tokens", 0)
+                   - before.get("spec_accepted_tokens", 0))
+    tokens = int(after.get("spec_verify_tokens", 0)
+                 - before.get("spec_verify_tokens", 0))
+    slot_steps = int(after.get("spec_verify_slot_steps", 0)
+                     - before.get("spec_verify_slot_steps", 0))
+    return {
+        "draft_tokens": drafted,
+        "accepted_tokens": accepted,
+        "verify_rounds": rounds,
+        "acceptance_rate": round(accepted / drafted, 4) if drafted else 0.0,
+        "tokens_per_step": (round(tokens / slot_steps, 4) if slot_steps
+                            else 0.0),
+    }
+
+
 def run_chat_bench(engine, n_turns: int = 6, system_len: int = 512,
                    user_len: int = 64, reply_len: int = 32,
                    warmup: bool = True):
@@ -328,6 +361,11 @@ def run_chat_bench(engine, n_turns: int = 6, system_len: int = 512,
         "prefix_cache_evicted_pages": int(
             after.get("prefix_cache_evicted_pages", 0)
             - before.get("prefix_cache_evicted_pages", 0)),
+        # Speculative decoding over the measured conversation (null
+        # when spec is off / nothing was draftable): chat replies
+        # copying spans of the history are prompt-lookup's best case,
+        # so this is the headline tokens-per-step scenario.
+        "spec": spec_snapshot(before, after),
     }
 
 
@@ -374,8 +412,10 @@ def run_openloop_bench(engine, *, rates, duration_s=10.0, slo_ttft_ms=500.0,
         "prompt_len_sigma": float(prompt_sigma),
         "output_len": int(out_len),
         "rates": [],
+        "spec": None,   # filled from the stats delta after the sweep
     }
     engine.start()
+    spec_before = engine.stats
     uid = 0   # unique per submission ACROSS rates — see prompt below
     for rate in rates:
         rng = _np.random.RandomState(seed)
@@ -442,6 +482,11 @@ def run_openloop_bench(engine, *, rates, duration_s=10.0, slo_ttft_ms=500.0,
                 if ttfts else None),
             "tokens_total": sum(len(s.token_ids) for s in streams),
         })
+    # Speculative decoding over the whole sweep (null when spec is off):
+    # open-loop prompts are cold/unique, so acceptance here reflects
+    # generated-token self-repetition, not warm prompt copying — the
+    # pessimistic bound next to the chat scenario's optimistic one.
+    out["spec"] = spec_snapshot(spec_before, engine.stats)
     return out
 
 

@@ -57,7 +57,7 @@ class IncrementalDetokenizer:
         return self._text + full[len(emitted):]
 
 
-class StopChecker:
+class StopWordTrap:
     """Stop-word scanning over the accumulated stream.
 
     Parity with the client-side stop-word drain in the reference
@@ -65,6 +65,17 @@ class StopChecker:
     accumulated text for stop strings and truncates). Returns the emittable
     portion of each chunk while withholding text that could be the start of
     a stop word.
+
+    Multi-token bursts: a speculative verify round (or the detokenizer
+    releasing held-back UTF-8 fragments) can deliver SEVERAL tokens'
+    text in one ``feed``. Truncation is at the EARLIEST stop occurrence
+    in the text across all stop words — the former first-in-list match
+    could stream text past an earlier stop word when two stops landed
+    in the same burst. Once tripped, every later ``feed``/``flush``
+    returns "" — trailing burst tokens the device already accepted are
+    text-invisible; the engine discards them from the stream's token
+    bookkeeping too (harvest skips a finished request's remaining rows)
+    and retires the slot, so no device state runs ahead of the stop.
     """
 
     def __init__(self, stop_words: list[str]):
@@ -76,12 +87,15 @@ class StopChecker:
         if self.stopped:
             return ""
         self._buf += chunk
-        for stop in self._stops:
-            idx = self._buf.find(stop)
-            if idx >= 0:
-                self.stopped = True
-                out, self._buf = self._buf[:idx], ""
-                return out
+        # Earliest occurrence across ALL stop words, not first match in
+        # list order — in a multi-token burst both can be present, and
+        # list order would leak text past the earlier stop.
+        idx = min((i for i in (self._buf.find(s) for s in self._stops)
+                   if i >= 0), default=-1)
+        if idx >= 0:
+            self.stopped = True
+            out, self._buf = self._buf[:idx], ""
+            return out
         # Withhold the longest suffix that is a prefix of any stop word.
         hold = 0
         for stop in self._stops:
@@ -98,3 +112,7 @@ class StopChecker:
     def flush(self) -> str:
         out, self._buf = self._buf, ""
         return "" if self.stopped else out
+
+
+# Back-compat alias (pre-round-9 name).
+StopChecker = StopWordTrap

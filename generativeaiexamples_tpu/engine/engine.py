@@ -61,7 +61,9 @@ from ..models.configs import LlamaConfig
 from ..models.tokenizer import Tokenizer
 from ..obs import flight as obs_flight
 from ..obs.tracing import record_stage
-from ..ops.fused_sampler import fused_unembed_sample
+from ..ops.fused_sampler import (choose_tile, fused_unembed_sample,
+                                 fused_verify_sample,
+                                 verify_reference_tiled)
 from ..ops.sampling import (apply_repetition_penalty, mask_words,
                             pack_mask, pack_mask_np, sample, seen_mask,
                             set_token_bits, unpack_mask)
@@ -69,10 +71,12 @@ from ..parallel.sharding import (llama_param_specs, paged_kv_cache_spec,
                                  shard_params)
 from ..utils import faults
 from ..utils.errors import ConfigError, EngineError, SchedulerFullError
-from .detokenizer import IncrementalDetokenizer, StopChecker
+from .detokenizer import IncrementalDetokenizer, StopWordTrap
 from .prefix_cache import PrefixCache, hash_blocks, usable_prefix_tokens
 from .sampling_params import SamplingParams
 from .scheduler import PrefillJob, StepCostModel, TokenBudgetScheduler
+from .spec_decode import (AdaptiveDraftController, PromptLookupDrafter,
+                          SpecConfig, spec_enabled)
 
 
 def _ceil_div(a: int, b: int) -> int:
@@ -131,6 +135,17 @@ _STATS_TEMPLATE = {
     # tail no longer pays for empty slots).
     "sampler_rows_sampled": 0,
     "sampler_rows_skipped": 0,
+    # Speculative decoding (engine/spec_decode.py): draft tokens
+    # proposed by the prompt-lookup drafter, how many of them the
+    # batched verify step accepted, verify rounds dispatched, tokens
+    # those rounds emitted (accepted drafts + the per-slot correction/
+    # bonus token), and slot participations in verify rounds (the
+    # denominator of the tokens-per-model-step multiplier).
+    "spec_draft_tokens": 0,
+    "spec_accepted_tokens": 0,
+    "spec_verify_rounds": 0,
+    "spec_verify_tokens": 0,
+    "spec_verify_slot_steps": 0,
 }
 
 
@@ -141,7 +156,8 @@ def engine_stat_keys() -> tuple[str, ...]:
     truth tools/check_metrics_docs.py checks the docs against."""
     from .prefix_cache import CacheStats
     return (tuple(_STATS_TEMPLATE)
-            + ("dispatch_queue_depth", "sched_prefill_share")
+            + ("dispatch_queue_depth", "sched_prefill_share",
+               "spec_acceptance_rate", "spec_tokens_per_step")
             + tuple(CacheStats().snapshot()) + ("prefix_cache_pages",))
 
 
@@ -240,6 +256,18 @@ class EngineConfig:
     # env vars override either (docs/configuration.md).
     sched_round_budget_tokens: Optional[int] = None
     sched_prefill_chunk_tokens: Optional[int] = None
+    # Speculative decoding (engine/spec_decode.py): host-side prompt-
+    # lookup drafting + one batched K+1-position verify forward per
+    # round, emitting up to K+1 tokens per slot per model step. Exact:
+    # greedy output is token-identical to the non-speculative engine,
+    # temperature>0 preserves the output distribution via rejection
+    # sampling. ENGINE_SPEC_DECODE env beats this field (0 restores the
+    # plain decode path); SPEC_MAX_DRAFT_TOKENS env beats the field
+    # below beats the default (docs/configuration.md). Single-chip
+    # only: under a mesh speculation is off (the verify tail rides the
+    # single-chip fused sampler contract).
+    spec_decode: bool = False
+    spec_max_draft_tokens: Optional[int] = None
 
     def __post_init__(self) -> None:
         # Geometry validation lives on the config, not the engine — a bad
@@ -261,6 +289,12 @@ class EngineConfig:
                 f"max_prefill_bucket={self.max_prefill_bucket} must be a "
                 f"multiple of page_size={self.page_size} (>= one page); "
                 f"pass a smaller page_size to serve finer prefill caps")
+        if self.spec_max_draft_tokens is not None \
+                and self.spec_max_draft_tokens < 1:
+            raise ConfigError(
+                f"spec_max_draft_tokens={self.spec_max_draft_tokens} "
+                f"must be >= 1 (it sizes the verify round's K+1 "
+                f"scoring positions)")
 
     @property
     def max_cache_len(self) -> int:
@@ -394,7 +428,7 @@ class _Request:
     prompt_ids: list[int]
     params: SamplingParams
     detok: IncrementalDetokenizer
-    stop: StopChecker
+    stop: StopWordTrap
     eff_max: int = 0          # max_tokens clamped to the cache extent
     extent: int = 0           # prompt + eff_max (cache positions reserved)
     slot: int = -1
@@ -437,6 +471,15 @@ class _Request:
     prefill_done: bool = False
     pf_pos: int = 0
     pf: Optional[dict] = None
+    # Speculative decoding (spec on only): the request's prompt-lookup
+    # drafter (host token index over prompt + generated), its adaptive
+    # draft-length controller, and the prompt's device length (the rag
+    # bucket for fused-RAG requests) — ``base_len + generated - 1`` is
+    # the slot's exact device ``pos``, used to re-anchor ``proj_pos``
+    # after each verify round's variable-length burst.
+    drafter: Optional[PromptLookupDrafter] = None
+    spec_ctrl: Optional[AdaptiveDraftController] = None
+    base_len: int = 0
 
     @property
     def done(self) -> bool:
@@ -606,6 +649,20 @@ class Engine:
         # (it doubles as the parity oracle in tests).
         self._fused_tail = (self.mesh is None and os.environ.get(
             "ENGINE_FUSED_SAMPLER", "1") != "0")
+        # Speculative decoding (engine/spec_decode.py): host-side
+        # prompt-lookup drafting + a batched verify round scoring
+        # S = max_draft + 1 positions per slot in ONE model step.
+        # Single-chip only (the verify tail rides the fused/materialized
+        # single-chip sampler paths; mesh serving keeps plain decode).
+        # ENGINE_SPEC_DECODE=0 restores the exact plain decode path.
+        self._spec: Optional[SpecConfig] = None
+        if self.mesh is None and spec_enabled(cfg.spec_decode):
+            self._spec = SpecConfig.resolve(cfg.spec_max_draft_tokens)
+        self._spec_S = (self._spec.max_draft_tokens + 1) if self._spec \
+            else 0
+        # Draft plan staged between _plan_round and _execute_plan
+        # (serve-loop thread only): {slot: [draft token ids]}.
+        self._draft_plan: Optional[dict] = None
         # Active-row ladder for the fused tail: decode rounds gather the
         # armed slots into the smallest rung >= the live count, so the
         # unembed/sampling tail is sized to OCCUPANCY, not max_slots.
@@ -1029,6 +1086,17 @@ class Engine:
         out["sched_prefill_share"] = (
             round(out["sched_prefill_tokens"] / sched_total, 4)
             if sched_total else 0.0)
+        # Speculative decoding: acceptance rate over all drafted tokens,
+        # and tokens emitted per verify slot-step (>1 = the speculative
+        # multiplier is real; 0.0 until the first verify round runs).
+        out["spec_acceptance_rate"] = (
+            round(out["spec_accepted_tokens"]
+                  / out["spec_draft_tokens"], 4)
+            if out["spec_draft_tokens"] else 0.0)
+        out["spec_tokens_per_step"] = (
+            round(out["spec_verify_tokens"]
+                  / out["spec_verify_slot_steps"], 4)
+            if out["spec_verify_slot_steps"] else 0.0)
         cache = self._prefix_cache
         if cache is not None:
             # Cache counters are written only on the serve-loop thread;
@@ -1290,6 +1358,208 @@ class Engine:
                 return state, toks
             return decode_round
 
+        def make_verify(window: int, greedy: bool, ba: int):
+            """One speculative VERIFY round: score S = max_draft + 1
+            positions per slot (the last accepted token + up to S-1
+            prompt-lookup drafts) through one multi-token paged forward
+            (llama.apply_verify_paged), run the vocab-tiled sampler on
+            every scored row, and accept on-device — emitting, per
+            active slot, the longest agreed draft prefix plus one
+            correction/bonus token. Exactness: greedy keeps a draft iff
+            it equals the row's argmax (token-identical to sequential
+            decode); temperature>0 rows use exact rejection sampling
+            (fused_verify_sample), so the output DISTRIBUTION matches
+            the non-speculative sampler. Rollback is free: ``pos``
+            advances only past consumed inputs, so rejected drafts'
+            K/V rows are dead weight the next step overwrites — pages
+            never advance past the last accepted token.
+
+            Returns (state, ((S, B) emitted tokens with -1 padding —
+            the classic round grid shape, so the harvest loop is
+            shared — and (B,) accepted-draft counts for stats and the
+            adaptive-K controllers))."""
+            fused = self._fused_tail
+            V = mcfg.vocab_size
+            S = self._spec_S
+            slen = self.MAX_BAD_LEN - 1
+
+            def verify_round(params, state, key, act_idx, drafts, n_draft):
+                pos, active = state["pos"], state["active"]
+                offs = jnp.arange(S, dtype=jnp.int32)
+                eff_pos = jnp.where(active, pos, 0)
+                positions = eff_pos[:, None] + offs[None, :]      # (B, S)
+                tokens = jnp.concatenate(
+                    [state["last_token"][:, None], drafts], axis=1)
+                # Writes: inactive slots and rows past the slot's draft
+                # count land in the trash page.
+                write_ok = active[:, None] \
+                    & (offs[None, :] <= n_draft[:, None])
+                page_idx = jnp.clip(positions // page, 0, self._pmax - 1)
+                page_of = jnp.take_along_axis(state["table"], page_idx,
+                                              axis=1)
+                wp = jnp.where(write_ok, page_of, 0)
+                net, cache = llama.apply_verify_paged(
+                    params, mcfg, tokens, positions, state["cache"],
+                    state["table"][:, :window], eff_pos + S, wp,
+                    positions % page, return_hidden=fused)
+                # Per-position sampler state: the seen mask / recent
+                # ring row j would carry after accepting drafts 0..j-1 —
+                # exactly the sequential path's (rows are only consumed
+                # when every preceding draft was accepted).
+                seen_list = [state["seen"]]
+                recent_list = [state["recent"]]
+                for j in range(1, S):
+                    d = drafts[:, j - 1]
+                    on = active & (j <= n_draft)
+                    seen_list.append(set_token_bits(seen_list[-1], d, on))
+                    recent_list.append(jnp.where(
+                        on[:, None],
+                        jnp.concatenate([recent_list[-1][:, 1:],
+                                         d[:, None]], axis=1),
+                        recent_list[-1]))
+                seen_pos = jnp.stack(seen_list, axis=1)      # (B, S, Wn)
+                recent_pos = jnp.stack(recent_list, axis=1)  # (B, S, sl)
+                # Row j verifies draft j (the token at input j+1); -1 on
+                # the bonus row (j == n_draft) and padding rows.
+                drafts_ext = jnp.concatenate(
+                    [drafts, jnp.full((B, 1), -1, jnp.int32)], axis=1)
+                draft_grid = jnp.where(offs[None, :] < n_draft[:, None],
+                                       drafts_ext, -1)
+                key_g = jax.random.fold_in(key, 0)
+                key_u = jax.random.fold_in(key, 1)
+                if fused:
+                    hn = llama.unembed_norm(params, mcfg, net)  # (B,S,D)
+                    ha = hn[act_idx].reshape(ba * S, -1)
+                    hit, tail = bad_seq_hits(
+                        jnp.repeat(state["bad_seq"][act_idx], S, axis=0),
+                        jnp.repeat(state["bad_len"][act_idx], S, axis=0),
+                        recent_pos[act_idx].reshape(ba * S, slen))
+                    temp_r = jnp.repeat(state["temp"][act_idx], S)
+                    tk_r = jnp.repeat(state["top_k"][act_idx], S)
+                    tp_r = jnp.repeat(state["top_p"][act_idx], S)
+                    rp_r = jnp.repeat(state["rep_pen"][act_idx], S)
+                    seen_r = seen_pos[act_idx].reshape(ba * S, -1)
+                    ban_r = jnp.repeat(state["banned"][act_idx], S,
+                                       axis=0)
+                    draft_r = draft_grid[act_idx].reshape(ba * S)
+
+                    def tile_fn(t0, tile):
+                        return llama.lm_head_tile(params, mcfg, ha, t0,
+                                                  tile)
+
+                    if greedy:
+                        tgt = fused_unembed_sample(
+                            tile_fn, V, key=key_g, temp=temp_r,
+                            top_k=tk_r, top_p=tp_r, rep_pen=rp_r,
+                            seen_words=seen_r, banned_words=ban_r,
+                            ban_tok=tail, ban_hit=hit, greedy=True)
+                        acc_r, out_r = draft_r == tgt, tgt
+                    else:
+                        u = jax.random.uniform(key_u, (ba * S,))
+                        acc_r, out_r = fused_verify_sample(
+                            tile_fn, V, key=key_g, u=u, temp=temp_r,
+                            top_k=tk_r, top_p=tp_r, rep_pen=rp_r,
+                            seen_words=seen_r, banned_words=ban_r,
+                            draft_ids=draft_r, ban_tok=tail, ban_hit=hit)
+                    # padding indices (== B) drop on scatter
+                    acc_g = jnp.zeros((B, S), bool).at[act_idx].set(
+                        acc_r.reshape(ba, S))
+                    out_g = jnp.zeros((B, S), jnp.int32).at[act_idx].set(
+                        out_r.reshape(ba, S))
+                else:
+                    # Materialized tail (ENGINE_FUSED_SAMPLER=0): same
+                    # verdict rule from full (B*S, V) penalized logits.
+                    # Greedy verdicts are identical to the fused tail
+                    # at any occupancy; sampled verdicts share the
+                    # per-tile noise layout but index rows B*S-wide
+                    # where the fused tail indexes its act_idx-gathered
+                    # ba*S rows — identical draws only at FULL
+                    # occupancy (act_idx == arange(B)); elsewhere the
+                    # tails are distribution-identical, not
+                    # sample-identical.
+                    lf = net.reshape(B * S, V)
+                    pen = apply_repetition_penalty(
+                        lf, unpack_mask(seen_pos.reshape(B * S, -1), V),
+                        jnp.repeat(state["rep_pen"], S))
+                    pen = jnp.where(
+                        unpack_mask(jnp.repeat(state["banned"], S,
+                                               axis=0), V),
+                        -1e30, pen)
+                    hit, tail = bad_seq_hits(
+                        jnp.repeat(state["bad_seq"], S, axis=0),
+                        jnp.repeat(state["bad_len"], S, axis=0),
+                        recent_pos.reshape(B * S, slen))
+                    pen = pen.at[jnp.arange(B * S)[:, None],
+                                 jnp.where(hit, tail, 0)].min(
+                        jnp.where(hit, -1e30, jnp.inf).astype(pen.dtype))
+                    draft_r = draft_grid.reshape(B * S)
+                    if greedy:
+                        tgt = jnp.argmax(pen.astype(jnp.float32),
+                                         axis=-1).astype(jnp.int32)
+                        acc_r, out_r = draft_r == tgt, tgt
+                    else:
+                        u = jax.random.uniform(key_u, (B * S,))
+                        acc_r, out_r = verify_reference_tiled(
+                            pen, key_g, u,
+                            jnp.repeat(state["temp"], S),
+                            jnp.repeat(state["top_k"], S),
+                            jnp.repeat(state["top_p"], S),
+                            draft_r, tile=choose_tile(V))
+                    acc_g = acc_r.reshape(B, S)
+                    out_g = out_r.reshape(B, S)
+                # Longest agreed prefix, then the correction/bonus token
+                # from its first disagreeing (or bonus) row.
+                valid_draft = offs[None, :] < n_draft[:, None]
+                chain = jnp.cumprod(
+                    (acc_g & valid_draft).astype(jnp.int32), axis=1)
+                a = chain.sum(axis=1)        # (B,) accepted draft count
+                corr = jnp.take_along_axis(out_g, a[:, None], axis=1)
+                e = jnp.where(offs[None, :] < a[:, None], drafts_ext,
+                              corr)
+                # eos / length termination INSIDE the burst, mirroring
+                # the sequential device rule: the terminal token itself
+                # is emitted, nothing after it is.
+                rem0 = state["remaining"]
+                is_eos = (e == eos) & state["eos_ok"][:, None]
+                stop_j = is_eos \
+                    | ((rem0[:, None] - (offs[None, :] + 1)) <= 0)
+                no_stop_before = jnp.cumprod(jnp.concatenate(
+                    [jnp.ones((B, 1), jnp.int32),
+                     (~stop_j[:, :-1]).astype(jnp.int32)], axis=1),
+                    axis=1)
+                emit = ((offs[None, :] <= a[:, None])
+                        & (no_stop_before > 0) & active[:, None])
+                m = emit.sum(axis=1)
+                last_tok = jnp.take_along_axis(
+                    e, jnp.maximum(m - 1, 0)[:, None], axis=1)[:, 0]
+                finished = active & jnp.any(emit & stop_j, axis=1)
+                seen = state["seen"]
+                recent = state["recent"]
+                for j in range(S):
+                    on = emit[:, j]
+                    seen = set_token_bits(seen, e[:, j], on)
+                    recent = jnp.where(
+                        on[:, None],
+                        jnp.concatenate([recent[:, 1:], e[:, j:j + 1]],
+                                        axis=1),
+                        recent)
+                new_state = dict(
+                    state,
+                    cache=self._pin_cache(cache),
+                    # pos advances past CONSUMED inputs only — the
+                    # rewind invariant: never past the last accepted
+                    # token (+1 for the input that produced it).
+                    pos=jnp.where(active, pos + m, pos),
+                    last_token=jnp.where(active, last_tok,
+                                         state["last_token"]),
+                    active=active & ~finished,
+                    remaining=jnp.where(active, rem0 - m, rem0),
+                    seen=seen, recent=recent)
+                return new_state, (jnp.where(emit, e, -1).T,
+                                   jnp.where(active, a, 0)
+                                   .astype(jnp.int32))
+            return verify_round
+
         def release(state, slot):
             return dict(state, active=state["active"].at[slot].set(False))
 
@@ -1314,7 +1584,9 @@ class Engine:
         self._prefill_insert_raw = prefill_insert  # for fused-RAG composition
         self._release = jax.jit(release, donate_argnums=(0,))
         self._make_round = make_round
+        self._make_verify = make_verify
         self._round_fns: dict[tuple[int, int, bool], object] = {}
+        self._verify_fns: dict[tuple, object] = {}
         self._chunk_fns: dict[tuple, object] = {}
 
     def _round_fn(self, window: int, steps: int, greedy: bool, ba: int):
@@ -1324,6 +1596,15 @@ class Engine:
             fn = jax.jit(self._make_round(window, steps, greedy, ba),
                          donate_argnums=(1,))
             self._round_fns[key] = fn
+        return fn
+
+    def _verify_fn(self, window: int, greedy: bool, ba: int):
+        key = (window, greedy, ba)
+        fn = self._verify_fns.get(key)
+        if fn is None:
+            fn = jax.jit(self._make_verify(window, greedy, ba),
+                         donate_argnums=(1,))
+            self._verify_fns[key] = fn
         return fn
 
     # --------------------------------------------- long-prompt admission
@@ -1846,14 +2127,24 @@ class Engine:
         req = _Request(stream=stream, prompt_ids=[], params=params,
                        eff_max=eff_max, extent=spec.bucket + eff_max,
                        detok=IncrementalDetokenizer(self.tokenizer),
-                       stop=StopChecker(params.stop_words),
+                       stop=StopWordTrap(params.stop_words),
                        greedy=(params.top_k == 1 or params.temperature <= 0),
                        banned_ids=banned_ids, bad_seqs=bad_seqs,
                        banned_np=banned_np, bad_seq_np=bad_seq_np,
                        bad_len_np=bad_len_np,
                        rag=(q_llm, len(ids), q_enc),
                        deadline_t=self._resolve_deadline(stream, deadline_t),
-                       seq=next(self._arrival_seq))
+                       seq=next(self._arrival_seq),
+                       base_len=spec.bucket)
+        if self._spec is not None:
+            # The fused-RAG prompt is assembled on-device — the host
+            # never sees its tokens, so the drafter indexes generated
+            # tokens only (prompt-lookup still fires once the answer
+            # starts repeating spans it generated).
+            req.drafter = PromptLookupDrafter(
+                ngram_max=self._spec.ngram_max,
+                ngram_min=self._spec.ngram_min)
+            req.spec_ctrl = AdaptiveDraftController(self._spec)
         self._enqueue(req, params, stream)
         if self._fatal is not None:
             stream._fail(self._fatal)
@@ -1936,13 +2227,21 @@ class Engine:
                        params=params, eff_max=eff_max,
                        extent=len(prompt_ids) + eff_max,
                        detok=IncrementalDetokenizer(self.tokenizer),
-                       stop=StopChecker(params.stop_words),
+                       stop=StopWordTrap(params.stop_words),
                        greedy=(params.top_k == 1 or params.temperature <= 0),
                        banned_ids=banned_ids, bad_seqs=bad_seqs,
                        banned_np=banned_np, bad_seq_np=bad_seq_np,
                        bad_len_np=bad_len_np,
                        deadline_t=self._resolve_deadline(stream, deadline_t),
-                       seq=next(self._arrival_seq))
+                       seq=next(self._arrival_seq),
+                       base_len=len(prompt_ids))
+        if self._spec is not None:
+            # Prompt-lookup index built on the SUBMITTING thread (like
+            # the bad-words masks): the serve loop only proposes.
+            req.drafter = PromptLookupDrafter(
+                prompt_ids, ngram_max=self._spec.ngram_max,
+                ngram_min=self._spec.ngram_min)
+            req.spec_ctrl = AdaptiveDraftController(self._spec)
         self._enqueue(req, params, stream)
         if self._fatal is not None:
             # The loop may have died between the check above and the put;
@@ -2153,7 +2452,12 @@ class Engine:
                                                      for x in arr[2:]]
                             self._emit_token(req, int(arr[0]))
                 else:
-                    _, members, toks_dev = item
+                    if kind == "verify":
+                        _, members, toks_dev, acc_dev, drafted = item
+                        accs = np.asarray(acc_dev)   # blocks off-thread
+                    else:
+                        _, members, toks_dev = item
+                        accs = drafted = None
                     toks = np.asarray(toks_dev)  # (K, B); blocks off-thread
                     wait = time.monotonic() - t0
                     record_stage("engine_harvest_wait", wait)
@@ -2166,6 +2470,12 @@ class Engine:
                         row = toks[k]
                         for slot, req in members.items():
                             if req.done:
+                                # A host-detected finish (stop word /
+                                # cancel / deadline) mid-burst: trailing
+                                # device-accepted tokens are DISCARDED —
+                                # never streamed, never counted, never
+                                # fed to the drafter (the slot retires,
+                                # so the device's advanced pos is moot).
                                 continue
                             tok = int(row[slot])
                             if tok < 0:
@@ -2179,6 +2489,9 @@ class Engine:
                         tl = members[slot].stream.timeline
                         if tl is not None:
                             tl.event("decode_round", n)
+                    if kind == "verify":
+                        self._finish_verify(members, accs, drafted,
+                                            emitted)
                     with self._pipe_lock:
                         # Guarded by the generation check just above: a
                         # worker disowned during the readback must not
@@ -2194,6 +2507,37 @@ class Engine:
             # fails every live request (all of them reachable via _slots /
             # _pending, including this item's members).
             self._wake.set()
+
+    def _finish_verify(self, members: dict, accs, drafted: dict,
+                       emitted: dict) -> None:
+        """Harvest-side bookkeeping of one verify round: speculative
+        stats, per-request flight-recorder draft/accept counts, the
+        adaptive-K controllers, and the ``proj_pos`` re-anchor (the
+        dispatch bumped it by the full S upper bound; the burst may
+        have consumed less — ``base_len + generated - 1`` is the exact
+        device pos for any armed slot). Runs on the harvest thread;
+        the scheduler only reads these fields after ``_queued_rounds``
+        drops to 0, which happens strictly after this returns."""
+        draft_total = sum(drafted.values())
+        accept_total = 0
+        for slot, req in members.items():
+            k = drafted.get(slot, 0)
+            a = min(int(accs[slot]), k)
+            accept_total += a
+            if k > 0 and req.spec_ctrl is not None:
+                req.spec_ctrl.update(k, a)
+            tl = req.stream.timeline
+            if tl is not None and (k or emitted.get(slot)):
+                tl.event("spec_drafted", k)
+                tl.event("spec_accepted", a)
+            if req.prefill_done and not req.done:
+                req.proj_pos = min(req.extent,
+                                   req.base_len + req.generated - 1)
+        with self._stats_lock:
+            self._stats["spec_draft_tokens"] += draft_total
+            self._stats["spec_accepted_tokens"] += accept_total
+            self._stats["spec_verify_tokens"] += sum(emitted.values())
+            self._stats["spec_verify_slot_steps"] += len(emitted)
 
     def _pull_pending(self) -> bool:
         """Drain the thread-safe intake queue into the scheduler's
@@ -2247,11 +2591,52 @@ class Engine:
         and are slack-ordered inside plan_round."""
         armed = [r for r in self._slots.values() if r.prefill_done]
         need_steps = max((r.extent - r.proj_pos for r in armed), default=0)
+
+        def ladder_steps() -> int:
+            # Right-size the classic round against the power-of-two
+            # step ladder — ONE definition, so spec-on and spec-off
+            # engines can never drift apart in round shape.
+            s = self.cfg.steps_per_round
+            while s // 2 >= need_steps:
+                s //= 2
+            return s
+
         steps = 0
-        if need_steps > 0 and self._queued_rounds() < self.cfg.dispatch_depth:
-            steps = self.cfg.steps_per_round
-            while steps // 2 >= need_steps:
-                steps //= 2
+        verify_cost = None
+        self._draft_plan = None
+        if self._spec is not None:
+            # Verify rounds require a DRAINED pipeline: the drafter
+            # needs the previous round's tokens on the host, so
+            # dispatch-ahead would draft blind — the up-to-S-tokens
+            # multiplier pays for that lost overlap. Rounds that will
+            # NOT draft gain nothing from the drain, so a workload with
+            # no repetition in sight keeps the PR-8 dispatch-ahead
+            # classic rounds instead of serializing for free.
+            if need_steps > 0 and self._queued_rounds() == 0:
+                self._draft_plan = self._plan_drafts(armed)
+                if self._draft_plan is not None:
+                    # One model step; priced as the S positions each
+                    # armed slot actually computes, converted through
+                    # the measured verify cost (StepCostModel).
+                    steps = 1
+                    verify_cost = self._sched.cost.verify_cost_tokens(
+                        self._spec_S * len(armed))
+                else:
+                    # Nothing draftable at the drain point: classic
+                    # multi-step round, the exact plain-decode program.
+                    steps = ladder_steps()
+            elif (need_steps > 0
+                    and self._queued_rounds() < self.cfg.dispatch_depth
+                    and not self._any_draftable(armed)):
+                # Pipeline is non-empty and no armed slot shows a
+                # draftable n-gram even on its (possibly stale) host
+                # context — dispatch ahead as plain decode always did.
+                # If a slot DOES look draftable, hold this round so the
+                # pipeline drains and the next plan can verify.
+                steps = ladder_steps()
+        elif need_steps > 0 \
+                and self._queued_rounds() < self.cfg.dispatch_depth:
+            steps = ladder_steps()
         inflight = [
             PrefillJob(key=r, remaining=len(r.prompt_ids) - r.pf_pos,
                        deadline_t=r.deadline_t, seq=r.seq, started=True)
@@ -2272,7 +2657,59 @@ class Engine:
         return self._sched.plan_round(
             decode_steps=steps, active_decodes=len(armed),
             inflight=inflight, backlog=backlog_jobs,
-            now=time.monotonic(), max_new=len(self._free_slots))
+            now=time.monotonic(), max_new=len(self._free_slots),
+            decode_cost_tokens=verify_cost)
+
+    def _any_draftable(self, armed) -> bool:
+        """Cheap hint: could any armed slot propose >= 1 draft token
+        right now? Used while rounds are still in flight — the host
+        context may lag the device by the unharvested rounds, so this
+        is a HINT for the pipeline-vs-drain decision, never the source
+        of actual drafts (those are proposed only at a drained
+        pipeline, where the context is exact). A stale positive just
+        drains the pipeline one round earlier than necessary; a stale
+        negative keeps one more round pipelined."""
+        for req in armed:
+            if req.drafter is None or req.spec_ctrl is None \
+                    or not req.stream.token_ids:
+                continue
+            if min(req.spec_ctrl.k,
+                   req.eff_max - req.generated - 1) <= 0:
+                continue
+            if req.drafter.propose(1):
+                return True
+        return False
+
+    def _plan_drafts(self, armed) -> Optional[dict]:
+        """Prompt-lookup proposals for this round: {slot: draft ids}.
+        None when no armed slot can draft — the caller then dispatches a
+        classic round instead (a verify round with zero drafts would
+        emit one token per slot at multi-token-forward prices).
+
+        Slots whose first token is still unharvested draft nothing (the
+        host index would be behind the device's last token — proposals
+        would verify against the wrong position's context); their rows
+        still ride the verify round and emit exactly one token, so
+        correctness never depends on the drafter's view."""
+        plan: dict[int, list[int]] = {}
+        total = 0
+        for req in armed:
+            if req.drafter is None or req.spec_ctrl is None \
+                    or not req.stream.token_ids:
+                continue
+            # Never draft past the request's remaining output budget:
+            # positions past it could write K/V beyond the allocated
+            # extent (the device would truncate the emission anyway,
+            # but the pages must stay in bounds).
+            k = min(req.spec_ctrl.k, self._spec.max_draft_tokens,
+                    req.eff_max - req.generated - 1)
+            if k <= 0:
+                continue
+            proposal = req.drafter.propose(k)
+            if proposal:
+                plan[req.slot] = proposal
+                total += len(proposal)
+        return plan if total else None
 
     def _execute_plan(self, plan) -> bool:
         """Dispatch one round plan: the decode round first (the latency-
@@ -2283,7 +2720,11 @@ class Engine:
         decoded = False
         t0 = time.monotonic()
         if plan.decode_steps:
-            decoded = self._dispatch_round(plan.decode_steps)
+            if self._draft_plan is not None:
+                decoded = self._dispatch_verify(self._draft_plan)
+                self._draft_plan = None
+            else:
+                decoded = self._dispatch_round(plan.decode_steps)
             if decoded:
                 did = True
                 self._bump("sched_decode_tokens", plan.decode_cost_tokens)
@@ -2673,6 +3114,76 @@ class Engine:
         self._bump("decode_steps", steps)
         return True
 
+    def _dispatch_verify(self, drafts: dict) -> bool:
+        """Dispatch one speculative VERIFY round: every armed slot rides
+        it (slots without proposals as plain 1-token rows), slots in
+        ``drafts`` carry their prompt-lookup proposals. One model step,
+        up to S tokens emitted per slot. Only called with the pipeline
+        drained (``_queued_rounds() == 0``), so the host's per-request
+        token lists — and therefore ``proj_pos`` — are exact."""
+        members = {s: r for s, r in self._slots.items() if r.prefill_done}
+        need_steps = max((r.extent - r.proj_pos
+                          for r in members.values()), default=0)
+        if need_steps <= 0 or not drafts:
+            return False
+        faults.inject("engine.dispatch")  # chaos: slow/failed decode round
+        S = self._spec_S
+        B = self.cfg.max_slots
+        page = self.cfg.page_size
+        # The gather window must cover every scored position (pos..
+        # pos+S-1 in-register rows included); proj_pos is exact here.
+        need = max(min(r.proj_pos + S, r.extent) + 1
+                   for r in members.values())
+        window = self._window_for(_ceil_div(need, page))
+        greedy = all(r.greedy for r in members.values())
+        ba = self._ba_for(len(members)) if self._fused_tail else B
+        act = np.full((ba,), B, np.int32)
+        act[:len(members)] = sorted(members)
+        draft_np = np.zeros((B, S - 1), np.int32)
+        n_np = np.zeros((B,), np.int32)
+        drafted: dict[int, int] = {}
+        for slot, toks in drafts.items():
+            k = min(len(toks), S - 1)
+            draft_np[slot, :k] = toks[:k]
+            n_np[slot] = k
+            drafted[slot] = k
+        key = jax.random.fold_in(self._base_key, next(self._step_counter))
+        t0 = time.monotonic()
+        new_state, (toks, acc) = self._verify_fn(window, greedy, ba)(
+            self.params, self._state, key, jnp.asarray(act),
+            jnp.asarray(draft_np), jnp.asarray(n_np))
+        self._guard_live()  # reset() may have run while the round compiled
+        self._state = new_state
+        dt = time.monotonic() - t0
+        # Speculative overhead attribution: host-side dispatch time of
+        # the verify round, globally and on each member's timeline (one
+        # stage event per round per slot — the decode_round budget).
+        record_stage("engine_verify", dt)
+        for req in members.values():
+            tl = req.stream.timeline
+            if tl is not None:
+                tl.stage("engine_verify", dt)
+        if self._fused_tail:
+            self._bump("sampler_rows_sampled", ba * S)
+            self._bump("sampler_rows_skipped", (B - ba) * S)
+        try:
+            toks.copy_to_host_async()
+            acc.copy_to_host_async()
+        except Exception:  # noqa: BLE001 — optional fast path
+            pass
+        for req in members.values():
+            req.proj_pos = min(req.proj_pos + S, req.extent)
+        with self._pipe_lock:
+            self._inflight_rounds += 1
+            depth = self._inflight_rounds
+        with self._stats_lock:
+            if depth > self._stats["dispatch_depth_peak"]:
+                self._stats["dispatch_depth_peak"] = depth
+        self._harvest_q.put(("verify", members, toks, acc, drafted))
+        self._bump("decode_steps")
+        self._bump("spec_verify_rounds")
+        return True
+
     def _emit_token(self, req: _Request, token: int) -> None:
         """Deliver one generated token (HARVEST-worker thread); finish the
         stream and post the completion for the scheduler to retire when
@@ -2682,6 +3193,11 @@ class Engine:
         release is the scheduler's job (_drain_completed)."""
         req.generated += 1
         req.stream.token_ids.append(token)
+        if req.drafter is not None:
+            # Keep the prompt-lookup index in step with the stream (the
+            # drafter only proposes between fully-harvested rounds, so
+            # this index is never behind the device at proposal time).
+            req.drafter.extend((token,))
         self._bump("tokens_generated")
         if req.stream.first_token_time is None:
             req.stream.first_token_time = time.monotonic()

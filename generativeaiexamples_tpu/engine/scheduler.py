@@ -63,6 +63,12 @@ class StepCostModel:
 
     decode_step_ms: float = 2.0
     prefill_ms_per_token: float = 0.125
+    # One speculative-verification position (one token of a K+1-token
+    # verify forward at decode occupancy, tools/profile_decode.py
+    # ``verify_ms_per_token``). 0 = unmeasured: verification is then
+    # priced 1:1 with prefill tokens (same forward math, the honest
+    # default until the artifact carries the measurement).
+    verify_ms_per_token: float = 0.0
     slots: int = 8
     source: str = "default"
 
@@ -80,8 +86,10 @@ class StepCostModel:
             # way a decode step amortizes it over slots; 4x is the
             # conservative end of the measured 3-8x range).
             prefill = decode / max(1, slots) / 4.0
+        verify = profile.get("verify_ms_per_token") or 0.0
         return cls(decode_step_ms=decode,
                    prefill_ms_per_token=float(prefill),
+                   verify_ms_per_token=float(verify),
                    slots=slots, source=source)
 
     @classmethod
@@ -127,6 +135,21 @@ class StepCostModel:
     def decode_round_ms(self, steps: int) -> float:
         return steps * self.decode_step_ms
 
+    def verify_cost_tokens(self, positions: int) -> int:
+        """Price a speculative verify round against the token budget:
+        ``positions`` scored positions (slots x S), converted to
+        prefill-token units through the measured per-token costs. With
+        no verify measurement the ratio is 1 — a verified position and
+        a prefill token run the same multi-token forward math, so 1:1
+        is the honest default rather than an optimistic discount."""
+        if positions <= 0:
+            return 0
+        if self.verify_ms_per_token <= 0 or self.prefill_ms_per_token <= 0:
+            return positions
+        return max(1, math.ceil(
+            positions * self.verify_ms_per_token
+            / self.prefill_ms_per_token))
+
 
 def derive_round_budget(model: StepCostModel, steps_per_round: int,
                         page_size: int) -> int:
@@ -167,11 +190,19 @@ class RoundPlan:
     active_decodes: int
     chunks: list = field(default_factory=list)  # [(key, grant_tokens)]
     budget_tokens: int = 0
+    # Explicit decode-work price for rounds whose cost is NOT steps x
+    # slots — a speculative verify round scores S positions per slot in
+    # one step (engine passes StepCostModel.verify_cost_tokens). None =
+    # the classic normalization below.
+    decode_cost_override: Optional[int] = None
 
     @property
     def decode_cost_tokens(self) -> int:
-        return self.decode_steps * max(1, self.active_decodes) \
-            if self.decode_steps else 0
+        if not self.decode_steps:
+            return 0
+        if self.decode_cost_override is not None:
+            return self.decode_cost_override
+        return self.decode_steps * max(1, self.active_decodes)
 
     @property
     def prefill_tokens(self) -> int:
@@ -252,7 +283,8 @@ class TokenBudgetScheduler:
                    inflight: Sequence[PrefillJob] = (),
                    backlog: Sequence[PrefillJob] = (),
                    now: float = 0.0,
-                   max_new: Optional[int] = None) -> RoundPlan:
+                   max_new: Optional[int] = None,
+                   decode_cost_tokens: Optional[int] = None) -> RoundPlan:
         """Pack one round: decode first (decode is NEVER displaced —
         stall-free batching means ongoing generations keep their
         cadence), then prefill chunks into the leftover budget.
@@ -264,7 +296,10 @@ class TokenBudgetScheduler:
         many of them (slack-order first) may be granted this round — the
         engine passes its free-slot count, so budget is never split
         across jobs that cannot start and then wasted when the executor
-        runs out of slots.
+        runs out of slots. ``decode_cost_tokens`` overrides the classic
+        steps x slots decode price for rounds whose work is shaped
+        differently — a speculative verify round scores S positions per
+        slot in one step (StepCostModel.verify_cost_tokens).
 
         Grants are whole pages except a job's FINAL grant (the engine's
         final-chunk program takes any tail length). Two liveness
@@ -281,7 +316,8 @@ class TokenBudgetScheduler:
         """
         plan = RoundPlan(decode_steps=decode_steps,
                          active_decodes=active_decodes,
-                         budget_tokens=self.round_budget_tokens)
+                         budget_tokens=self.round_budget_tokens,
+                         decode_cost_override=decode_cost_tokens)
         admitted = self.order(backlog, now)
         if max_new is not None:
             admitted = admitted[:max(0, max_new)]

@@ -1,0 +1,216 @@
+"""Speculative decoding: host-side drafting policy for the decode loop.
+
+The engine's decode rounds emit at most ONE token per model step per
+slot.  Once decode is bandwidth-bound (round 8: slot-grouped page
+streaming + fused unembed/sampling), the next multiplier on tokens/s is
+emitting MORE than one token per step: propose a few cheap draft tokens,
+score all of them in one multi-token forward (models/llama.py
+``apply_verify_paged``), and keep the longest prefix the model agrees
+with (Leviathan et al., "Fast Inference from Transformers via
+Speculative Decoding").  Acceptance is exact: greedy verification keeps
+a draft token iff it equals the model's argmax at that position, and for
+temperature>0 the fused sampler's rejection-sampling path
+(ops/fused_sampler.py ``fused_verify_sample``) preserves the output
+DISTRIBUTION token for token.
+
+This module is the host-side half — pure Python, no jax:
+
+- :class:`PromptLookupDrafter` — draft-model-free n-gram drafting
+  (Saxena, "Prompt Lookup Decoding"): propose the continuation of the
+  most recent earlier occurrence of the current context's suffix
+  n-gram.  RAG is the best case — answers copy long spans verbatim from
+  retrieved context, so the prompt itself is the draft model — and it
+  needs zero extra weights, which is also why it is benchable on this
+  repo's random-init weights (a learned draft model could not help
+  there).
+- :class:`AdaptiveDraftController` — per-request draft length K,
+  adapted to the recent acceptance rate so a request that stops copying
+  stops paying for dead draft positions.
+- :class:`SpecConfig` — the resolved knob set (env beats EngineConfig
+  beats defaults; docs/configuration.md "Speculative decoding").
+
+The device-side half lives in engine.py (``make_verify`` round builder:
+batched K+1-position verification through the paged KV pool, rejected
+positions rewound by simply not advancing ``pos`` past the last
+accepted token — pages never advance past it, so prefix-cache block
+hashes stay consistent) and ops/fused_sampler.py (verification rows
+ride the vocab-tiled path; no (B, V) tensor ever exists).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Iterable, Optional, Sequence
+
+DEFAULT_MAX_DRAFT = 7      # K: draft tokens per slot per round (S = K+1)
+DEFAULT_MIN_DRAFT = 1
+DEFAULT_NGRAM_MAX = 3
+DEFAULT_NGRAM_MIN = 1
+DEFAULT_ADAPT_HIGH = 0.8   # acceptance >= high -> grow K
+DEFAULT_ADAPT_LOW = 0.3    # acceptance < low  -> halve K
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "")
+    return int(raw) if raw else default
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "")
+    return float(raw) if raw else default
+
+
+@dataclass(frozen=True)
+class SpecConfig:
+    """Resolved speculative-decoding knobs for one engine.
+
+    ``max_draft_tokens`` is a per-ENGINE compile-shape constant (the
+    verify round scores ``max_draft_tokens + 1`` positions per slot in
+    one static-shape program); the per-request ADAPTIVE K moves inside
+    [min_draft_tokens, max_draft_tokens] without recompiling."""
+
+    max_draft_tokens: int = DEFAULT_MAX_DRAFT
+    min_draft_tokens: int = DEFAULT_MIN_DRAFT
+    ngram_max: int = DEFAULT_NGRAM_MAX
+    ngram_min: int = DEFAULT_NGRAM_MIN
+    adapt: bool = True
+    adapt_high: float = DEFAULT_ADAPT_HIGH
+    adapt_low: float = DEFAULT_ADAPT_LOW
+
+    @classmethod
+    def resolve(cls, cfg_max_draft: Optional[int] = None) -> "SpecConfig":
+        """Env beats the EngineConfig field beats the default — the same
+        precedence as the SCHED_*/BENCH_* knob families."""
+        env_max = os.environ.get("SPEC_MAX_DRAFT_TOKENS", "")
+        max_draft = int(env_max) if env_max else (
+            cfg_max_draft or DEFAULT_MAX_DRAFT)
+        max_draft = max(1, max_draft)
+        min_draft = max(1, min(
+            _env_int("SPEC_MIN_DRAFT_TOKENS", DEFAULT_MIN_DRAFT),
+            max_draft))
+        ngram_max = max(1, _env_int("SPEC_NGRAM_MAX", DEFAULT_NGRAM_MAX))
+        ngram_min = max(1, min(_env_int("SPEC_NGRAM_MIN",
+                                        DEFAULT_NGRAM_MIN), ngram_max))
+        return cls(
+            max_draft_tokens=max_draft,
+            min_draft_tokens=min_draft,
+            ngram_max=ngram_max,
+            ngram_min=ngram_min,
+            adapt=os.environ.get("SPEC_ADAPT", "1") != "0",
+            adapt_high=_env_float("SPEC_ADAPT_HIGH", DEFAULT_ADAPT_HIGH),
+            adapt_low=_env_float("SPEC_ADAPT_LOW", DEFAULT_ADAPT_LOW))
+
+
+def spec_enabled(cfg_flag: bool) -> bool:
+    """ENGINE_SPEC_DECODE env beats the EngineConfig.spec_decode field:
+    ``0`` forces the exact PR-8 decode path whatever the config says
+    (the parity escape hatch the acceptance tests pin), any other
+    non-empty value forces speculation on, unset defers to the config."""
+    env = os.environ.get("ENGINE_SPEC_DECODE", "")
+    if env == "":
+        return bool(cfg_flag)
+    return env != "0"
+
+
+class PromptLookupDrafter:
+    """N-gram prompt-lookup drafting over one request's prompt +
+    generated tokens.
+
+    ``propose(k)`` finds the LONGEST suffix n-gram (``ngram_max`` down
+    to ``ngram_min``) of the context that also occurs earlier, and
+    proposes up to ``k`` tokens following that earlier occurrence — the
+    "the answer is copying a span it has seen" bet.  The index is
+    incremental: each appended token registers the n-grams ending at it,
+    so a proposal is O(ngram sizes) dict lookups, not a scan of the
+    context (the engine calls this once per slot per round).
+
+    Only the MOST RECENT earlier occurrence is kept (plus the one
+    before it, so the suffix's own registration never shadows a real
+    match) — recency is the right prior for RAG answers, which copy the
+    span they are currently quoting, and it keeps the index O(context)
+    however long the request runs.
+    """
+
+    def __init__(self, context: Sequence[int] = (), *,
+                 ngram_max: int = DEFAULT_NGRAM_MAX,
+                 ngram_min: int = DEFAULT_NGRAM_MIN):
+        self.ngram_max = max(1, ngram_max)
+        self.ngram_min = max(1, min(ngram_min, self.ngram_max))
+        self._ids: list[int] = []
+        self._last: dict = {}   # (n, gram) -> latest start index
+        self._prev: dict = {}   # (n, gram) -> start index before that
+        if context:
+            self.extend(context)
+
+    def __len__(self) -> int:
+        return len(self._ids)
+
+    def extend(self, tokens: Iterable[int]) -> None:
+        ids = self._ids
+        for tok in tokens:
+            ids.append(int(tok))
+            L = len(ids)
+            for n in range(self.ngram_min, self.ngram_max + 1):
+                if L < n:
+                    break
+                key = (n, tuple(ids[L - n:]))
+                old = self._last.get(key)
+                if old is not None:
+                    self._prev[key] = old
+                self._last[key] = L - n
+
+    def propose(self, k: int) -> list[int]:
+        """Up to ``k`` draft tokens, or ``[]`` when no suffix n-gram has
+        an earlier occurrence (the engine then skips drafting for this
+        slot this round — a free miss, not an error)."""
+        if k <= 0:
+            return []
+        ids = self._ids
+        L = len(ids)
+        for n in range(self.ngram_max, self.ngram_min - 1, -1):
+            if L < n + 1:   # need at least one token after the match
+                continue
+            key = (n, tuple(ids[L - n:]))
+            start = self._last.get(key)
+            if start == L - n:      # that's the suffix itself
+                start = self._prev.get(key)
+            if start is None:
+                continue
+            cont = ids[start + n:start + n + k]
+            if cont:
+                return list(cont)
+        return []
+
+
+class AdaptiveDraftController:
+    """Per-request draft length K, adapted to recent acceptance.
+
+    Multiplicative-decrease / additive-increase on the INSTANTANEOUS
+    per-round acceptance rate (a burst is K <= 8 drafts, so one round
+    is already a meaningful sample and reacting on it converges in a
+    couple of rounds; the engine-wide smoothed signal lives in the
+    ``spec_acceptance_rate`` gauge): a round accepting >= ``high`` of
+    its drafts grows K by one (toward ``k_max``), one accepting <
+    ``low`` halves it (toward ``k_min``).  Misses are cheap but not
+    free — every draft position is a real verified forward position
+    priced against the round budget — so a request that stopped
+    copying converges to ``k_min`` within a few rounds instead of
+    paying K dead positions forever.  ``adapt=False`` pins K at
+    ``k_max`` (the measurement configuration for acceptance-rate
+    studies)."""
+
+    def __init__(self, spec: SpecConfig):
+        self._spec = spec
+        self.k = spec.max_draft_tokens
+
+    def update(self, drafted: int, accepted: int) -> None:
+        if drafted <= 0:
+            return
+        rate = accepted / drafted
+        if not self._spec.adapt:
+            return
+        if rate >= self._spec.adapt_high:
+            self.k = min(self._spec.max_draft_tokens, self.k + 1)
+        elif rate < self._spec.adapt_low:
+            self.k = max(self._spec.min_draft_tokens, self.k // 2)

@@ -402,6 +402,111 @@ def apply_decode_paged(params: Params, cfg: LlamaConfig, tokens: jax.Array,
     return (h if return_hidden else unembed(params, cfg, h)), cache
 
 
+def apply_verify_paged(params: Params, cfg: LlamaConfig, tokens: jax.Array,
+                       positions: jax.Array, kv_cache: KVCache,
+                       block_table: jax.Array, kv_valid_len: jax.Array,
+                       write_pages: jax.Array, write_offsets: jax.Array,
+                       return_hidden: bool = False,
+                       ) -> tuple[jax.Array, KVCache]:
+    """Multi-token decode step over the paged KV pool: the speculative-
+    decoding VERIFICATION forward (engine/spec_decode.py).
+
+    Scores ``S`` consecutive positions per slot in ONE forward — the
+    last accepted token plus up to S-1 draft tokens — so the engine can
+    emit several tokens per model step.  tokens/positions: (B, S) with
+    each row's positions contiguous (``pos .. pos+S-1``).
+    write_pages/write_offsets: (B, S) physical destination of EACH
+    token's K/V (page 0 = trash for inactive slots and positions past
+    the slot's draft count).  kv_valid_len: (B,) = ``pos + S`` — the
+    causal mask inside :func:`gqa_attention` restricts each query to
+    keys at positions <= its own, so draft token j attends the pool
+    prefix plus drafts 0..j-1 exactly as a sequential decode would.
+
+    Rollback discipline: rejected drafts need NO explicit undo.  Their
+    K/V rows land at positions past the last accepted token; the engine
+    simply does not advance ``pos`` past acceptance, so the next step's
+    writes overwrite them and reads (masked by ``pos``) never see them
+    — pages never advance past the last accepted token and prefix-cache
+    block hashes (pure prompt blocks) stay consistent.
+
+    This is the jnp gather path only — the mirror of
+    ``apply_decode_paged``'s fallback branch generalized to S tokens.
+    The Pallas decode kernel stays single-token (its per-slot DMA loop
+    is shaped around one query row); verify rounds take this path on
+    every backend, trading a gathered window per layer for the K+1
+    scoring positions.  Same memory discipline: the layer scan only
+    READS the pool, each layer's new K/V rides the scan outputs, and
+    the pool is updated with one post-scan scatter.
+    """
+    B, S = tokens.shape
+    P = block_table.shape[1]
+    page = kv_cache["k"].shape[3]  # (L, N, KV, page, hd)
+    inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta,
+                                cfg.rope_scaling_factor)
+    h = jnp.take(params["embed"], tokens, axis=0)
+    rows = jnp.arange(B)
+    quant = kv_cache_quantized(kv_cache)
+
+    def layer(h: jax.Array, xs):
+        if quant:
+            lp, kc, vc, ksc, vsc = xs
+        else:
+            lp, kc, vc = xs
+            ksc = vsc = None
+
+        def attend(q, k, v):
+            kg = _gathered_window(kc, ksc, block_table, B, P, page, cfg,
+                                  h.dtype)
+            vg = _gathered_window(vc, vsc, block_table, B, P, page, cfg,
+                                  h.dtype)
+            # All S current tokens join the window in-register at their
+            # logical positions (their pool writes happen in the
+            # post-scan scatter); positions past the window drop on
+            # scatter — they can only belong to masked garbage rows.
+            kg = kg.at[rows[:, None], positions].set(k.astype(kg.dtype))
+            vg = vg.at[rows[:, None], positions].set(v.astype(vg.dtype))
+            return gqa_attention(q, kg, vg, positions, kv_valid_len), \
+                (k, v)
+
+        return decoder_layer(h, lp, cfg, positions, inv_freq, kv_valid_len,
+                             attend=attend)
+
+    xs = (params["layers"], kv_cache["k"], kv_cache["v"])
+    if quant:
+        xs = xs + (kv_cache["ks"], kv_cache["vs"])
+    h, (new_k, new_v) = jax.lax.scan(layer, h, xs)
+    # new_k/new_v: (L, B, S, KV, hd) -> one scatter into the pool, one
+    # flat row index per (slot, token, kv-head).
+    L_, N_, KV_, page_, hd_ = kv_cache["k"].shape
+    flat_idx = ((write_pages[:, :, None] * KV_
+                 + jnp.arange(KV_)[None, None, :])
+                * page_ + write_offsets[:, :, None])       # (B, S, KV)
+
+    def write(pool, new):
+        flat = pool.reshape(L_, N_ * KV_ * page_, hd_)
+        flat = flat.at[:, flat_idx].set(new.astype(pool.dtype))
+        return flat.reshape(L_, N_, KV_, page_, hd_)
+
+    if quant:
+        from ..ops.kv_quant import quantize_rows
+
+        def write_scale(pool, new_s):
+            flat = pool.reshape(L_, N_ * KV_ * page_)
+            flat = flat.at[:, flat_idx].set(new_s.astype(pool.dtype))
+            return flat.reshape(L_, N_, KV_, page_)
+
+        kq, ksn = quantize_rows(new_k)
+        vq, vsn = quantize_rows(new_v)
+        cache = {"k": write(kv_cache["k"], kq),
+                 "v": write(kv_cache["v"], vq),
+                 "ks": write_scale(kv_cache["ks"], ksn),
+                 "vs": write_scale(kv_cache["vs"], vsn)}
+    else:
+        cache = {"k": write(kv_cache["k"], new_k),
+                 "v": write(kv_cache["v"], new_v)}
+    return (h if return_hidden else unembed(params, cfg, h)), cache
+
+
 def _paged_prefix_attention(q, k_self, v_self, kc, vc, ksc, vsc,
                             block_table, start, kv_valid_len, page: int,
                             cfg: LlamaConfig, block_pages: int = 8):

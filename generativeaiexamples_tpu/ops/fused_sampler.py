@@ -217,6 +217,194 @@ def fused_unembed_sample(tile_logits_fn, vocab_size: int, *, key, temp,
     return jnp.where(is_greedy, brid, sampled).astype(jnp.int32)
 
 
+def fused_verify_sample(tile_logits_fn, vocab_size: int, *, key, u, temp,
+                        top_k, top_p, rep_pen, seen_words, banned_words,
+                        draft_ids, ban_tok=None, ban_hit=None,
+                        tile: int | None = None,
+                        cand_k: int | None = None
+                        ) -> tuple[jax.Array, jax.Array]:
+    """Speculative-decoding verification on the vocab-tiled stream:
+    per row, the EXACT rejection-sampling verdict for one draft token,
+    without materializing (R, V) logits.
+
+    Each row scores one position; ``draft_ids[r]`` is the draft token
+    proposed there (−1 = no draft: a bonus/padding row that always
+    "rejects" and resamples from the full target distribution).
+    ``u``: (R,) uniforms in [0, 1) drawn by the caller (shared with the
+    reference oracle so exactness is testable token-for-token).
+
+    Returns ``(accept, out_tok)``:
+
+    - ``accept[r]`` — keep the draft token (prompt-lookup drafting is a
+      point mass, so Leviathan et al.'s ``min(1, p/q)`` test reduces to
+      ``u < p(draft)`` under the penalized+truncated target
+      distribution; a greedy row — temp<=0 or top_k==1 — accepts iff
+      the draft equals the running argmax);
+    - ``out_tok[r]`` — the token to emit at the FIRST rejected position
+      (a sample from the residual ``p`` with the draft token removed,
+      renormalized — with a point-mass proposal the residual is exactly
+      that) or at the bonus position (draft −1 masks nothing, so the
+      residual IS ``p``).  Greedy rows return the argmax.
+
+    Sequentially applying this rule position by position leaves the
+    output distribution identical to non-speculative sampling (the
+    fixed-key distribution-preservation test pins it).  Exactness
+    contract matches :func:`fused_unembed_sample`: rows whose kept
+    top-k/top-p prefix fits ``cand_k`` candidates are sample-exact vs
+    :func:`verify_reference_tiled`; a draft outside the candidate set
+    of a truncated row has p = 0 there (it cannot be in the kept set).
+    """
+    tile = choose_tile(vocab_size, tile)
+    cand_k = cand_k or default_cand_k()
+    n_tiles = vocab_size // tile
+    probe = jax.eval_shape(lambda: tile_logits_fn(jnp.int32(0), tile))
+    R = probe.shape[0]
+    tf = jnp.maximum(temp, 1e-6)[:, None]
+
+    def body(carry, t):
+        (cv, ci, cp, lse, braw, brid, sd, sfound, npert, npid) = carry
+        t0 = (t * tile).astype(jnp.int32)
+        lf = _penalize_tile(
+            tile_logits_fn(t0, tile), t0, tile, seen_words=seen_words,
+            banned_words=banned_words, rep_pen=rep_pen,
+            ban_tok=ban_tok, ban_hit=ban_hit)
+        ids = t0 + jnp.arange(tile, dtype=jnp.int32)
+        idb = jnp.broadcast_to(ids, lf.shape)
+        scaled = lf / tf
+        g = jax.random.gumbel(jax.random.fold_in(key, t),
+                              (R, tile), jnp.float32)
+        pert = scaled + g
+        lse = jnp.logaddexp(lse, jax.nn.logsumexp(scaled, axis=-1))
+        # running greedy argmax (greedy rows + the greedy accept test)
+        rb = jnp.max(lf, axis=-1)
+        ri = jnp.take_along_axis(idb, jnp.argmax(lf, -1)[:, None],
+                                 axis=1)[:, 0]
+        ug = rb > braw
+        braw, brid = jnp.where(ug, rb, braw), jnp.where(ug, ri, brid)
+        # the draft token's scaled logit (each id lives in exactly one
+        # tile, so a masked sum is a gather)
+        dm = idb == draft_ids[:, None]
+        sd = sd + jnp.sum(jnp.where(dm, scaled, 0.0), axis=-1)
+        sfound = sfound | jnp.any(dm, axis=-1)
+        # running Gumbel-argmax with the draft masked: the UNTRUNCATED
+        # residual sample (draft -1 matches nothing -> plain sample)
+        pert_nod = jnp.where(dm, -jnp.inf, pert)
+        nb = jnp.max(pert_nod, axis=-1)
+        ni = jnp.take_along_axis(idb, jnp.argmax(pert_nod, -1)[:, None],
+                                 axis=1)[:, 0]
+        un = nb > npert
+        npert, npid = jnp.where(un, nb, npert), jnp.where(un, ni, npid)
+        # candidate merge (identical to fused_unembed_sample: carry-first
+        # preserves the oracle's stable tie order)
+        av = jnp.concatenate([cv, scaled], axis=-1)
+        ai = jnp.concatenate([ci, idb], axis=-1)
+        ap = jnp.concatenate([cp, pert], axis=-1)
+        cv, sel = jax.lax.top_k(av, cand_k)
+        ci = jnp.take_along_axis(ai, sel, axis=-1)
+        cp = jnp.take_along_axis(ap, sel, axis=-1)
+        return (cv, ci, cp, lse, braw, brid, sd, sfound, npert, npid), None
+
+    init = (jnp.full((R, cand_k), -jnp.inf, jnp.float32),
+            jnp.zeros((R, cand_k), jnp.int32),
+            jnp.full((R, cand_k), -jnp.inf, jnp.float32),
+            jnp.full((R,), -jnp.inf, jnp.float32),
+            jnp.full((R,), -jnp.inf, jnp.float32),
+            jnp.zeros((R,), jnp.int32),
+            jnp.zeros((R,), jnp.float32),
+            jnp.zeros((R,), bool),
+            jnp.full((R,), -jnp.inf, jnp.float32),
+            jnp.zeros((R,), jnp.int32))
+    (cv, ci, cp, lse, _, brid, sd, sfound, _, npid), _ = jax.lax.scan(
+        body, init, jnp.arange(n_tiles, dtype=jnp.int32))
+    sd = jnp.where(sfound, sd, -jnp.inf)
+
+    V = vocab_size
+    kk = jnp.where(top_k <= 0, V, top_k)
+    p = jnp.where((top_p <= 0) | (top_p >= 1.0), 1.0, top_p)
+    probs = jnp.exp(cv - lse[:, None])
+    cum_before = jnp.cumsum(probs, axis=-1) - probs
+    keep = ((jnp.arange(cand_k)[None, :] < kk[:, None])
+            & (cum_before < p[:, None]))
+    # Truncated target: normalizer over the KEPT candidates only; the
+    # draft's probability is exp(scaled_d - Z_kept) when the draft made
+    # the kept set, else exactly 0.
+    z_kept = jax.nn.logsumexp(jnp.where(keep, cv, -jnp.inf), axis=-1)
+    is_draft = ci == draft_ids[:, None]
+    draft_kept = jnp.any(is_draft & keep, axis=-1)
+    p_trunc = jnp.where(draft_kept, jnp.exp(sd - z_kept), 0.0)
+    # Truncated residual: Gumbel-argmax over kept candidates minus the
+    # draft.  A kept set of exactly {draft} has an empty residual — but
+    # then p(draft) == 1 and the residual is never consumed; fall back
+    # to the draft itself so a float-rounded reject can't emit ci[0].
+    kept_res = keep & ~is_draft
+    res_pert = jnp.where(kept_res, cp, -jnp.inf)
+    trunc_res = jnp.take_along_axis(
+        ci, jnp.argmax(res_pert, -1)[:, None], axis=1)[:, 0]
+    trunc_res = jnp.where(jnp.any(kept_res, axis=-1), trunc_res,
+                          draft_ids)
+    untruncated = (kk >= V) & (p >= 1.0)
+    p_acc = jnp.where(untruncated, jnp.exp(sd - lse), p_trunc)
+    resample = jnp.where(untruncated, npid, trunc_res)
+    accept = u < p_acc
+    out_tok = resample.astype(jnp.int32)
+    is_greedy = (temp <= 0) | (top_k == 1)
+    accept = jnp.where(is_greedy, draft_ids == brid, accept)
+    out_tok = jnp.where(is_greedy, brid, out_tok)
+    return accept, out_tok
+
+
+def verify_reference_tiled(logits, key, u, temp, top_k, top_p, draft_ids,
+                           tile: int) -> tuple[jax.Array, jax.Array]:
+    """Materialized oracle for :func:`fused_verify_sample`: full (R, V)
+    penalized logits in, the same accept/resample verdicts out, sharing
+    the fused path's per-tile Gumbel noise layout and uniforms — the
+    fused path must produce IDENTICAL verdicts for the same key
+    whenever the kept prefix fits its candidate carry (tier-1 pinned).
+    Also the verification tail for the engine's materialized
+    (non-fused) decode path under ``ENGINE_FUSED_SAMPLER=0`` / mesh
+    serving."""
+    R, V = logits.shape
+    lf = logits.astype(jnp.float32)
+    greedy_ids = jnp.argmax(lf, axis=-1).astype(jnp.int32)
+    scaled = lf / jnp.maximum(temp, 1e-6)[:, None]
+    sort_idx = jnp.argsort(-scaled, axis=-1)
+    ranks = jnp.zeros_like(sort_idx).at[
+        jnp.arange(R)[:, None], sort_idx
+    ].set(jnp.broadcast_to(jnp.arange(V), (R, V)))
+    kk = jnp.where(top_k[:, None] <= 0, V, top_k[:, None])
+    keep = ranks < kk
+    sorted_logits = jnp.take_along_axis(scaled, sort_idx, axis=-1)
+    sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(sorted_probs, axis=-1)
+    p = jnp.where((top_p[:, None] <= 0) | (top_p[:, None] >= 1.0),
+                  1.0, top_p[:, None])
+    sorted_keep_p = (cum - sorted_probs) < p
+    keep_p = jnp.zeros_like(keep).at[
+        jnp.arange(R)[:, None], sort_idx
+    ].set(sorted_keep_p)
+    kept = keep & keep_p
+    is_draft = jnp.arange(V)[None, :] == draft_ids[:, None]
+    sd = jnp.where(jnp.any(is_draft, axis=-1),
+                   jnp.sum(jnp.where(is_draft, scaled, 0.0), axis=-1),
+                   -jnp.inf)
+    untruncated = (kk[:, 0] >= V) & (p[:, 0] >= 1.0)
+    z_kept = jax.nn.logsumexp(jnp.where(kept, scaled, -jnp.inf), axis=-1)
+    lse = jax.nn.logsumexp(scaled, axis=-1)
+    draft_kept = jnp.any(is_draft & kept, axis=-1)
+    p_trunc = jnp.where(draft_kept, jnp.exp(sd - z_kept), 0.0)
+    p_acc = jnp.where(untruncated, jnp.exp(sd - lse), p_trunc)
+    pert = scaled + tiled_gumbel(key, R, V, tile)
+    kept_res = kept & ~is_draft
+    masked = jnp.where(kept_res, pert, -jnp.inf)
+    resample = jnp.argmax(masked, axis=-1).astype(jnp.int32)
+    resample = jnp.where(jnp.any(kept_res, axis=-1), resample, draft_ids)
+    accept = u < p_acc
+    is_greedy = (temp <= 0) | (top_k == 1)
+    accept = jnp.where(is_greedy, draft_ids == greedy_ids, accept)
+    out_tok = jnp.where(is_greedy, greedy_ids, resample)
+    return accept, out_tok.astype(jnp.int32)
+
+
 def tiled_gumbel(key, batch: int, vocab_size: int, tile: int) -> jax.Array:
     """The full (B, V) Gumbel field the fused sampler consumes tile by
     tile — oracle/test use only (it materializes what the fused path

@@ -25,6 +25,12 @@ def synthetic_result() -> dict:
         "warm_min_ttft_ms": 110.0, "warm_ttfts_ms": [120.0, 121.5],
         "prefix_cache_hit_tokens": 1024, "prefix_cache_hit_rate": 0.8,
         "prefix_cache_evicted_pages": 0,
+        # built through the real emit path so the spec contract is
+        # pinned exactly as bench.py produces it
+        "spec": bench.spec_snapshot(
+            {}, {"spec_verify_rounds": 10, "spec_draft_tokens": 40,
+                 "spec_accepted_tokens": 28, "spec_verify_tokens": 52,
+                 "spec_verify_slot_steps": 24}),
     }
     dist = {"p99": 190.0, "min": 170.0, "max": 190.0,
             "batch_p50s": [178.0, 180.0, 179.0], "samples": 24}
@@ -141,3 +147,26 @@ def test_nested_chat_contract_pinned():
     result["chat"]["warm_ttft_ms"] = 1.0  # unknown chat key
     with pytest.raises(BenchSchemaError, match="warm_ttft_ms"):
         validate_result(result)
+
+
+def test_spec_block_contract_pinned():
+    """The nested speculative-decoding block is validated element-wise:
+    spec_snapshot's keys ARE the schema's spec section, a rename inside
+    chat.spec fails fast, and spec: null (spec off) stays valid."""
+    schema = load_schema()
+    snap = bench.spec_snapshot({}, {"spec_verify_rounds": 1})
+    assert set(snap) == set(schema["spec"])
+    result = synthetic_result()
+    validate_result(dict(result, chat=dict(result["chat"], spec=None)))
+    result["chat"]["spec"]["accept_rate"] = \
+        result["chat"]["spec"].pop("acceptance_rate")
+    with pytest.raises(BenchSchemaError, match=r"chat.spec"):
+        validate_result(result)
+
+
+def test_spec_snapshot_none_without_verify_rounds():
+    """A window with no verify round (spec off) publishes null, not a
+    block of zeros pretending speculation ran."""
+    assert bench.spec_snapshot({}, {}) is None
+    assert bench.spec_snapshot({"spec_verify_rounds": 4},
+                               {"spec_verify_rounds": 4}) is None

@@ -22,6 +22,9 @@ SHARED_KEYS = {
     "matmul_floor_ms_per_step",
     # step-cost model inputs for the token-budget scheduler
     "prefill_bucket_tokens", "prefill_ms_per_token",
+    # geometry of the per-rung speculative-verify measurement (the S in
+    # each rung's S-position verify_ms_per_step)
+    "verify_positions",
 }
 
 RUNG_KEYS = {
@@ -30,16 +33,19 @@ RUNG_KEYS = {
     "unembed_ms_per_step", "window_stream_ms_per_step", "tokens_per_sec",
     # roofline: must-move bytes over measured step time vs chip peak
     "achieved_bw_gbps", "achieved_bw_fraction",
+    # speculative verify step at this occupancy (StepCostModel pricing)
+    "verify_ms_per_step", "verify_ms_per_token",
 }
 
 REQUIRED_KEYS = SHARED_KEYS | RUNG_KEYS
 
 # Sweep shape: shared keys + the rung list + the StepCostModel mirror
 # keys (engine/scheduler.py reads full_ms_per_step/slots/
-# prefill_ms_per_token at TOP level, so a sweep artifact committed as
-# the newest PROFILE_rNN still feeds the scheduler's cost model).
+# prefill_ms_per_token/verify_ms_per_token at TOP level, so a sweep
+# artifact committed as the newest PROFILE_rNN still feeds the
+# scheduler's cost model).
 SWEEP_KEYS = SHARED_KEYS | {"slots_sweep", "rungs", "slots",
-                            "full_ms_per_step"}
+                            "full_ms_per_step", "verify_ms_per_token"}
 
 
 def _setenv(monkeypatch):
@@ -99,6 +105,10 @@ def test_profile_decode_slots_sweep_artifact(tmp_path, monkeypatch):
             == on_disk["rungs"][0]["full_ms_per_step"])
     model = StepCostModel.from_profile(on_disk, source=path)
     assert model.decode_step_ms == on_disk["full_ms_per_step"]
+    assert model.verify_ms_per_token == on_disk["verify_ms_per_token"]
+    # verify pricing: ratio of the measured per-token costs, ceil'd
+    assert model.verify_cost_tokens(0) == 0
+    assert model.verify_cost_tokens(16) >= 1
 
 
 def test_committed_round_artifact_is_valid():

@@ -1,0 +1,557 @@
+"""Speculative decoding (engine/spec_decode.py + the verify round).
+
+The contract this suite pins, layer by layer:
+
+- **Drafting** (host): prompt-lookup n-gram proposals, longest-suffix
+  preference, recency, the adaptive-K controller.
+- **Verification sampler** (ops/fused_sampler.py): the vocab-tiled
+  ``fused_verify_sample`` is verdict-identical to the materialized
+  ``verify_reference_tiled`` oracle under fixed keys, and the
+  rejection-sampling rule PRESERVES the target distribution — the
+  acceptance criterion's "output distribution is unchanged".
+- **Engine** exactness: greedy speculative decoding is TOKEN-IDENTICAL
+  to the non-speculative engine across chat-shaped (multi-turn, warm
+  prefix-cache) and openloop-shaped (concurrent cold burst) mini-runs,
+  including a stop word completing mid-burst; ``ENGINE_SPEC_DECODE=0``
+  restores the exact plain decode path.
+- **Memory**: the verify round's jaxpr never materializes a
+  (rows, V) intermediate — the round-8 assertion with verification
+  rows enabled.
+- **Bench**: the chat scenario's ``spec.tokens_per_step`` clears 1.5 on
+  the copy-heavy CPU mix, and the schema-validated ``spec`` block is
+  emitted.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from generativeaiexamples_tpu.engine import Engine, EngineConfig, SamplingParams
+from generativeaiexamples_tpu.engine.detokenizer import StopWordTrap
+from generativeaiexamples_tpu.engine.scheduler import StepCostModel
+from generativeaiexamples_tpu.engine.spec_decode import (
+    AdaptiveDraftController, PromptLookupDrafter, SpecConfig, spec_enabled)
+from generativeaiexamples_tpu.models import llama
+from generativeaiexamples_tpu.models.configs import LlamaConfig
+from generativeaiexamples_tpu.models.tokenizer import ByteTokenizer
+from generativeaiexamples_tpu.ops.fused_sampler import (
+    choose_tile, fused_verify_sample, verify_reference_tiled)
+from generativeaiexamples_tpu.ops.sampling import mask_words, pack_mask_np
+
+CFG = LlamaConfig(vocab_size=259 + 5, hidden_size=64, intermediate_size=128,
+                  num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+                  max_position_embeddings=1024)
+
+
+def make_engine(params, spec: bool, **kw):
+    base = dict(max_slots=4, max_input_length=96, max_output_length=32,
+                prefill_buckets=(16, 32, 96), page_size=16,
+                dtype="float32", max_queue=64, spec_decode=spec)
+    base.update(kw)
+    return Engine(params, CFG, ByteTokenizer(), EngineConfig(**base))
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(CFG, jax.random.key(7), dtype=jnp.float32)
+
+
+# ------------------------------------------------------------- drafter
+
+
+def test_drafter_proposes_continuation_of_last_match():
+    d = PromptLookupDrafter([1, 2, 3, 9, 9, 1, 2, 3, 7, 8, 1, 2, 3],
+                            ngram_max=3, ngram_min=1)
+    # suffix trigram (1,2,3) last occurred earlier at index 5 -> 7, 8
+    assert d.propose(2) == [7, 8]
+    assert d.propose(5) == [7, 8, 1, 2, 3]   # continuation clipped to k
+
+
+def test_drafter_prefers_longest_ngram():
+    # suffix (5, 6): bigram match at 1 -> continue 7; unigram 6 also
+    # occurs at 3 (-> 9) but the longer match must win
+    d = PromptLookupDrafter([5, 6, 7, 6, 9, 5, 6], ngram_max=3,
+                            ngram_min=1)
+    assert d.propose(1) == [7]
+
+
+def test_drafter_no_match_returns_empty():
+    d = PromptLookupDrafter([1, 2, 3, 4, 5], ngram_max=3, ngram_min=1)
+    assert d.propose(4) == []
+    assert d.propose(0) == []
+
+
+def test_drafter_recency_and_incremental_extend():
+    d = PromptLookupDrafter([4, 1, 7, 4, 1, 8], ngram_max=2, ngram_min=1)
+    d.extend([4, 1])
+    # most RECENT earlier occurrence of (4, 1) is index 3 -> 8
+    assert d.propose(1) == [8]
+    # constant run: the longest suffix n-gram matches one position back,
+    # so the continuation is the run's next token
+    d2 = PromptLookupDrafter([9, 9, 9], ngram_max=3, ngram_min=1)
+    assert d2.propose(2) == [9]
+
+
+def test_adaptive_controller_grows_and_shrinks():
+    spec = SpecConfig(max_draft_tokens=8, min_draft_tokens=1)
+    ctrl = AdaptiveDraftController(spec)
+    assert ctrl.k == 8
+    ctrl.update(8, 1)          # 12.5% acceptance -> halve
+    assert ctrl.k == 4
+    ctrl.update(4, 0)
+    ctrl.update(2, 0)
+    ctrl.update(1, 0)
+    assert ctrl.k == 1         # floored at min
+    for _ in range(10):
+        ctrl.update(1, 1)      # perfect acceptance -> +1 per round
+    assert ctrl.k == 8         # capped at max
+    pinned = AdaptiveDraftController(
+        SpecConfig(max_draft_tokens=6, adapt=False))
+    pinned.update(6, 0)
+    assert pinned.k == 6       # SPEC_ADAPT=0 pins K
+
+
+def test_spec_enabled_env_precedence(monkeypatch):
+    monkeypatch.delenv("ENGINE_SPEC_DECODE", raising=False)
+    assert spec_enabled(True) and not spec_enabled(False)
+    monkeypatch.setenv("ENGINE_SPEC_DECODE", "0")
+    assert not spec_enabled(True)
+    monkeypatch.setenv("ENGINE_SPEC_DECODE", "1")
+    assert spec_enabled(False)
+
+
+# ------------------------------------------- verification sampler (ops)
+
+
+def test_fused_verify_matches_reference_oracle():
+    """Fixed-key verdict exactness: the tiled verify sampler and the
+    materialized oracle agree on every accept decision AND every
+    resample token, across greedy/sampled rows, truncations, drafts
+    in/out of the kept set, and no-draft (-1) bonus rows."""
+    V, R = 256, 16
+    tile = choose_tile(V, 64)
+    rng = np.random.RandomState(0)
+    for trial in range(8):
+        logits = jnp.asarray(rng.randn(R, V).astype(np.float32) * 3)
+        temp = jnp.asarray(rng.choice([0.0, 0.7, 1.0], R).astype(np.float32))
+        top_k = jnp.asarray(rng.choice([0, 1, 5, 40], R).astype(np.int32))
+        top_p = jnp.asarray(rng.choice([1.0, 0.9, 0.5], R).astype(np.float32))
+        draft = rng.randint(-1, V, size=R).astype(np.int32)
+        draft[:4] = np.asarray(jnp.argmax(logits[:4], -1))  # likely accepts
+        draft = jnp.asarray(draft)
+        seen = np.zeros((R, V), bool)
+        seen[rng.rand(R, V) < 0.05] = True
+        key = jax.random.key(trial)
+        u = jax.random.uniform(jax.random.fold_in(key, 999), (R,))
+        acc_f, out_f = fused_verify_sample(
+            lambda t0, t: jax.lax.dynamic_slice_in_dim(logits, t0, t,
+                                                       axis=1),
+            V, key=key, u=u, temp=temp, top_k=top_k, top_p=top_p,
+            rep_pen=jnp.ones((R,), jnp.float32),
+            seen_words=jnp.asarray(pack_mask_np(seen)),
+            banned_words=jnp.zeros((R, mask_words(V)), jnp.uint32),
+            draft_ids=draft, tile=tile, cand_k=64)
+        acc_r, out_r = verify_reference_tiled(logits, key, u, temp, top_k,
+                                              top_p, draft, tile)
+        np.testing.assert_array_equal(np.asarray(acc_f), np.asarray(acc_r))
+        np.testing.assert_array_equal(np.asarray(out_f), np.asarray(out_r))
+
+
+@pytest.mark.parametrize("top_k,top_p", [(0, 1.0), (8, 1.0), (0, 0.7)])
+def test_rejection_sampling_preserves_distribution(top_k, top_p):
+    """Distribution preservation (fixed key, batched): accept-with-p(d)
+    then resample-from-residual must leave the emitted token's marginal
+    equal to the target truncated softmax — acceptance rate == p(draft)
+    and total-variation distance at sampling-noise level."""
+    V, N = 64, 4000
+    tile = choose_tile(V, 32)
+    base = np.random.RandomState(1).randn(V).astype(np.float32) * 2
+    logits = jnp.asarray(np.tile(base, (N, 1)))
+    # target distribution under the same truncation rule
+    scaled = base / 0.8
+    order = np.argsort(-scaled)
+    probs = np.exp(scaled - scaled.max())
+    probs /= probs.sum()
+    kk = top_k if top_k > 0 else V
+    sp = probs[order]
+    cum = np.cumsum(sp)
+    keeps = (cum - sp) < (top_p if 0 < top_p < 1 else 1.0)
+    keep = np.zeros(V, bool)
+    for r, idx in enumerate(order):
+        keep[idx] = r < kk and keeps[r]
+    target = np.where(keep, probs, 0)
+    target /= target.sum()
+    draft = int(order[1])     # a likely-but-not-top token
+    key = jax.random.key(42)
+    u = jax.random.uniform(jax.random.fold_in(key, 999), (N,))
+    acc, out = fused_verify_sample(
+        lambda t0, t: jax.lax.dynamic_slice_in_dim(logits, t0, t, axis=1),
+        V, key=key, u=u, temp=jnp.full((N,), 0.8),
+        top_k=jnp.full((N,), top_k, jnp.int32),
+        top_p=jnp.full((N,), top_p, jnp.float32),
+        rep_pen=jnp.ones((N,), jnp.float32),
+        seen_words=jnp.zeros((N, mask_words(V)), jnp.uint32),
+        banned_words=jnp.zeros((N, mask_words(V)), jnp.uint32),
+        draft_ids=jnp.full((N,), draft, jnp.int32), tile=tile, cand_k=64)
+    emitted = np.where(np.asarray(acc), draft, np.asarray(out))
+    accept_rate = float(np.asarray(acc).mean())
+    assert abs(accept_rate - target[draft]) < 0.03
+    emp = np.bincount(emitted, minlength=V) / N
+    tv = 0.5 * np.abs(emp - target).sum()
+    assert tv < 0.06, f"TV distance {tv} — distribution not preserved"
+
+
+def test_verify_rejected_draft_never_reemitted_in_truncated_mode():
+    """With a point-mass proposal the residual excludes the draft: a
+    rejected draft must not come back as the resample (unless the kept
+    set is exactly {draft}, where p=1 makes rejection impossible)."""
+    V, N = 64, 512
+    tile = choose_tile(V, 32)
+    base = np.random.RandomState(3).randn(V).astype(np.float32)
+    logits = jnp.asarray(np.tile(base, (N, 1)))
+    draft = int(np.argsort(-base)[2])
+    key = jax.random.key(9)
+    u = jax.random.uniform(jax.random.fold_in(key, 999), (N,))
+    acc, out = fused_verify_sample(
+        lambda t0, t: jax.lax.dynamic_slice_in_dim(logits, t0, t, axis=1),
+        V, key=key, u=u, temp=jnp.ones((N,)),
+        top_k=jnp.full((N,), 8, jnp.int32), top_p=jnp.ones((N,)),
+        rep_pen=jnp.ones((N,), jnp.float32),
+        seen_words=jnp.zeros((N, mask_words(V)), jnp.uint32),
+        banned_words=jnp.zeros((N, mask_words(V)), jnp.uint32),
+        draft_ids=jnp.full((N,), draft, jnp.int32), tile=tile, cand_k=64)
+    rejected = ~np.asarray(acc)
+    assert rejected.any()
+    assert not (np.asarray(out)[rejected] == draft).any()
+
+
+# ------------------------------------------------- engine-level parity
+
+
+def _greedy_burst(eng, prompts, max_tokens=20, stop_words=None):
+    sp = SamplingParams(max_tokens=max_tokens, top_k=1, ignore_eos=True,
+                        stop_words=stop_words or [])
+    streams = [eng.submit(list(p), sp) for p in prompts]
+    return [(s.text(), list(s.token_ids), s.finish_reason)
+            for s in streams]
+
+
+def test_greedy_spec_token_identical_openloop_burst(params):
+    """Openloop-shaped mini-run: a concurrent burst of unique cold
+    prompts (more requests than slots) must be token-identical with
+    speculation on — drafts that verify wrong are corrected exactly."""
+    rng = np.random.RandomState(0)
+    prompts = [list(rng.randint(4, 200, size=n))
+               for n in (24, 11, 17, 30, 9, 21)]
+    with make_engine(params, spec=False) as eng:
+        base = _greedy_burst(eng, prompts)
+    with make_engine(params, spec=True) as eng:
+        spec = _greedy_burst(eng, prompts)
+        stats = eng.stats
+    assert base == spec
+    assert stats["spec_verify_rounds"] > 0, "speculation never engaged"
+    assert stats["spec_draft_tokens"] > 0
+
+
+def test_greedy_spec_token_identical_chat_warm_prefix(params):
+    """Chat-shaped mini-run: multi-turn history re-submission, so turn
+    2+ admits through the prefix cache (warm start) — the verify path
+    must stay token-identical on top of cache-seeded slots."""
+    results = {}
+    for spec in (False, True):
+        with make_engine(params, spec=spec) as eng:
+            history = [(7 * i) % 200 + 4 for i in range(48)]
+            turns = []
+            for t in range(3):
+                prompt = (history + [11 + t, 12, 13])[-90:]
+                s = eng.submit(prompt, SamplingParams(
+                    max_tokens=10, top_k=1, ignore_eos=True))
+                s.text()
+                turns.append(list(s.token_ids))
+                history = prompt + s.token_ids
+            results[spec] = turns
+            hits = eng.stats["prefix_cache_hit_tokens"]
+    assert hits > 0, "scenario never warmed the prefix cache"
+    assert results[False] == results[True]
+
+
+def test_stop_word_mid_burst_truncates_exactly(params):
+    """A stop word completing mid-burst: the stream must end exactly
+    where the non-speculative engine ends it — same text (nothing past
+    the stop), same token ids (trailing device-accepted tokens
+    discarded), same finish reason — and the slot/pages must be free
+    afterwards."""
+    tok = ByteTokenizer()
+    prompt = tok.encode("stop test")
+    with make_engine(params, spec=False) as eng:
+        free = eng.submit(prompt, SamplingParams(
+            max_tokens=16, top_k=1, ignore_eos=True))
+        full_text = free.text()
+    assert len(full_text) >= 3, "scenario needs visible text"
+    stop = full_text[2]
+    out = {}
+    for spec in (False, True):
+        with make_engine(params, spec=spec) as eng:
+            s = eng.submit(prompt, SamplingParams(
+                max_tokens=16, top_k=1, ignore_eos=True,
+                stop_words=[stop]))
+            out[spec] = (s.text(), list(s.token_ids), s.finish_reason)
+            if spec:
+                # retirement is the scheduler's half of completion and
+                # runs after the stream's sentinel — poll for it, then
+                # assert the slot and its pages actually came back
+                import time as _t
+                deadline = _t.monotonic() + 10
+                while eng._slots and _t.monotonic() < deadline:
+                    _t.sleep(0.01)
+                assert not eng._slots
+                assert len(eng._free_slots) == eng.cfg.max_slots
+    assert out[True][2] == "stop"
+    assert stop not in out[True][0]
+    assert out[False] == out[True]
+
+
+def test_env_zero_restores_plain_decode_path(params, monkeypatch):
+    """ENGINE_SPEC_DECODE=0 beats spec_decode=True: no drafter state, no
+    verify rounds, token-identical output — the engine-level parity
+    escape hatch the acceptance criteria pin."""
+    prompt = [9, 10, 11, 12] * 6
+    with make_engine(params, spec=False) as eng:
+        base = eng.submit(prompt, SamplingParams(
+            max_tokens=12, top_k=1, ignore_eos=True))
+        base.text()
+    monkeypatch.setenv("ENGINE_SPEC_DECODE", "0")
+    with make_engine(params, spec=True) as eng:
+        assert eng._spec is None
+        s = eng.submit(prompt, SamplingParams(
+            max_tokens=12, top_k=1, ignore_eos=True))
+        s.text()
+        stats = eng.stats
+    assert stats["spec_verify_rounds"] == 0
+    assert stats["spec_draft_tokens"] == 0
+    assert s.token_ids == base.token_ids
+
+
+def test_nondraftable_workload_keeps_pipelined_classic_rounds(params):
+    """Spec on + a workload with no self-repetition: every round falls
+    back to the classic program — token-identical to spec-off, zero
+    verify rounds — and the planner's draftable HINT stays False, so
+    dispatch-ahead is allowed while rounds are in flight (enabling
+    spec on a non-copy workload must cost nothing)."""
+    # strictly non-repeating token sequence: no n-gram ever recurs
+    prompt = list(range(4, 4 + 40))
+    with make_engine(params, spec=False) as eng:
+        base = eng.submit(prompt, SamplingParams(
+            max_tokens=12, top_k=1, ignore_eos=True))
+        base.text()
+    with make_engine(params, spec=True) as eng:
+        s = eng.submit(prompt, SamplingParams(
+            max_tokens=12, top_k=1, ignore_eos=True))
+        s.text()
+        stats = eng.stats
+        # the draftable hint drives the pipeline-vs-drain decision:
+        # non-repeating context -> False (pipelined classic rounds),
+        # repeating context -> True (hold for a verify round)
+        from types import SimpleNamespace as NS
+
+        def fake(ctx):
+            return NS(drafter=PromptLookupDrafter(ctx, ngram_max=3,
+                                                  ngram_min=1),
+                      spec_ctrl=AdaptiveDraftController(eng._spec),
+                      eff_max=32, generated=1,
+                      stream=NS(token_ids=list(ctx[-1:])))
+        assert eng._any_draftable([fake(list(range(4, 40)))]) is False
+        assert eng._any_draftable([fake([7, 8, 9] * 5)]) is True
+    # generated tokens MAY repeat (model's choice) and then verify
+    # rounds legitimately run; but with this model/prompt the output
+    # must simply match spec-off whatever path each round took
+    assert s.token_ids == base.token_ids
+    with make_engine(params, spec=True) as eng:
+        # and a repetitive workload still verifies under the hint-gated
+        # policy (long enough that the drain + draft opportunity comes)
+        a = eng.submit([9, 10, 11, 12] * 8, SamplingParams(
+            max_tokens=24, top_k=1, ignore_eos=True))
+        a.text()
+        assert eng.stats["spec_verify_rounds"] > 0
+
+
+def test_sampling_spec_runs_and_respects_length(params):
+    """Temperature>0 through the verify path: mechanical soundness
+    (exact distribution preservation is pinned at the sampler layer) —
+    requested lengths honored, mixed greedy/sampled batch fine."""
+    with make_engine(params, spec=True) as eng:
+        # the sampled request rides verify rounds triggered by the
+        # greedy batch-mate's repetitive (hint-positive) context
+        a = eng.submit([9, 10, 11, 12] * 8, SamplingParams(
+            max_tokens=20, temperature=0.7, top_k=8, top_p=0.9,
+            ignore_eos=True))
+        b = eng.submit([9, 10, 11, 12] * 8, SamplingParams(
+            max_tokens=24, top_k=1, ignore_eos=True))
+        a.text(), b.text()
+        stats = eng.stats
+    assert len(a.token_ids) == 20 and len(b.token_ids) == 24
+    assert stats["spec_verify_rounds"] > 0
+
+
+def test_spec_stats_and_flight_events(params):
+    """Observability satellite: the spec counters move, the derived
+    acceptance-rate / tokens-per-step gauges agree with the raw ones,
+    and per-round draft/accept counts + the engine_verify stage land on
+    the request's flight timeline."""
+    from generativeaiexamples_tpu.obs import flight as obs_flight
+
+    with make_engine(params, spec=True) as eng:
+        rec = obs_flight.FlightRecorder()
+        eng.flight = rec
+        s = eng.submit([9, 10, 11, 12] * 8, SamplingParams(
+            max_tokens=24, top_k=1, ignore_eos=True))
+        s.text()
+        stats = eng.stats
+        tl = rec.find(s.request_id)
+    assert stats["spec_verify_rounds"] > 0
+    assert stats["spec_verify_tokens"] >= stats["spec_verify_slot_steps"]
+    if stats["spec_draft_tokens"]:
+        assert stats["spec_acceptance_rate"] == round(
+            stats["spec_accepted_tokens"] / stats["spec_draft_tokens"], 4)
+    assert stats["spec_tokens_per_step"] == round(
+        stats["spec_verify_tokens"] / stats["spec_verify_slot_steps"], 4)
+    names = [e[2] for e in tl.events_snapshot()]
+    assert "spec_drafted" in names and "spec_accepted" in names
+    assert "engine_verify" in names
+
+
+def test_verify_cost_priced_against_budget(params):
+    """Scheduler satellite: verify rounds charge sched_decode_tokens
+    through StepCostModel.verify_cost_tokens (not steps x slots), and
+    the cost model's ratio pricing behaves."""
+    cost = StepCostModel(prefill_ms_per_token=0.1, verify_ms_per_token=0.2)
+    assert cost.verify_cost_tokens(10) == 20    # 2x prefill-token price
+    assert StepCostModel().verify_cost_tokens(10) == 10   # unmeasured 1:1
+    assert cost.verify_cost_tokens(0) == 0
+    with make_engine(params, spec=True) as eng:
+        s = eng.submit([9, 10, 11, 12] * 8, SamplingParams(
+            max_tokens=20, top_k=1, ignore_eos=True))
+        s.text()
+        stats = eng.stats
+    assert stats["spec_verify_rounds"] > 0
+    assert stats["sched_decode_tokens"] > 0
+
+
+# -------------------------------------------------- memory proof (r8)
+
+
+def _jaxprs_in(val):
+    if isinstance(val, jax.core.ClosedJaxpr):
+        yield val.jaxpr
+    elif isinstance(val, jax.core.Jaxpr):
+        yield val
+    elif isinstance(val, (list, tuple)):
+        for v in val:
+            yield from _jaxprs_in(v)
+
+
+def _walk_avals(jaxpr, out):
+    for eqn in jaxpr.eqns:
+        out.extend(v.aval for v in eqn.outvars)
+        for val in eqn.params.values():
+            for sub in _jaxprs_in(val):
+                _walk_avals(sub, out)
+
+
+def test_verify_round_never_materializes_vocab(monkeypatch):
+    """The round-8 memory contract WITH verification rows: trace the
+    engine's actual fused verify round (sampling variant — the
+    stricter one: rejection probabilities, residual samples and
+    candidate carries all in play) and assert no intermediate anywhere
+    in the jaxpr carries a full (rows, V) array."""
+    vocab = 288                                   # 9 mask words, 3 tiles
+    monkeypatch.setenv("SAMPLER_TILE", "96")
+    monkeypatch.setenv("SAMPLER_CAND_K", "16")
+    cfg = LlamaConfig(vocab_size=vocab, hidden_size=64,
+                      intermediate_size=128, num_layers=2, num_heads=4,
+                      num_kv_heads=2, head_dim=16,
+                      max_position_embeddings=256)
+    params = llama.init_params(cfg, jax.random.key(0), dtype=jnp.float32)
+    eng = Engine(params, cfg, ByteTokenizer(), EngineConfig(
+        max_slots=4, max_input_length=64, max_output_length=32,
+        prefill_buckets=(16, 32, 64), dtype="float32", max_queue=8,
+        spec_decode=True, spec_max_draft_tokens=3))
+    try:
+        assert eng._fused_tail and eng._spec is not None
+        ba = 2
+        S = eng._spec_S
+        fn = eng._make_verify(eng._windows[0], False, ba)
+        jaxpr = jax.make_jaxpr(fn)(
+            eng.params, eng._state, jax.random.key(1),
+            jnp.zeros((ba,), jnp.int32),
+            jnp.zeros((eng.cfg.max_slots, S - 1), jnp.int32),
+            jnp.zeros((eng.cfg.max_slots,), jnp.int32)).jaxpr
+        avals = []
+        _walk_avals(jaxpr, avals)
+        offenders = [a for a in avals
+                     if getattr(a, "ndim", 0) >= 2
+                     and a.shape[-1] == vocab]
+        assert not offenders, (
+            f"verify round materializes vocab-wide intermediates: "
+            f"{[(a.shape, str(a.dtype)) for a in offenders]}")
+        assert any(getattr(a, "ndim", 0) >= 2 and a.shape[-1] == 96
+                   for a in avals), "expected (rows, tile) intermediates"
+    finally:
+        eng.stop()
+
+
+# ------------------------------------------------------- StopWordTrap
+
+
+def test_stopwordtrap_earliest_stop_wins_in_burst():
+    """Multi-token bursts deliver several tokens' text in one feed: the
+    trap must truncate at the EARLIEST stop occurrence in the text, not
+    at the first stop word in list order (the pre-round-9 latent bug),
+    and stay silent once tripped."""
+    trap = StopWordTrap(["zz", "b"])
+    assert trap.feed("a b c zz d") == "a "
+    assert trap.stopped
+    assert trap.feed("more") == ""
+    assert trap.flush() == ""
+    # single-feed burst where the LIST-first stop sits later in the text
+    trap2 = StopWordTrap(["late", "x"])
+    assert trap2.feed("01x23late") == "01"
+    # back-compat alias still importable
+    from generativeaiexamples_tpu.engine.detokenizer import StopChecker
+    assert StopChecker is StopWordTrap
+
+
+# ---------------------------------------------------------- bench smoke
+
+
+def test_chat_bench_spec_tokens_per_step(params_key0=None):
+    """Acceptance criterion: the chat scenario (copy-heavy prompt mix —
+    growing shared history, greedy replies that cycle) reports
+    spec.tokens_per_step > 1.5 on CPU with speculation on, and the
+    block validates against the bench schema."""
+    import bench
+    from tools.check_bench_schema import load_schema
+
+    cfg = LlamaConfig(vocab_size=259 + 5, hidden_size=64,
+                      intermediate_size=128, num_layers=2, num_heads=4,
+                      num_kv_heads=2, head_dim=16,
+                      max_position_embeddings=1024)
+    params = llama.init_params(cfg, jax.random.key(0), dtype=jnp.float32)
+    eng = Engine(params, cfg, ByteTokenizer(), EngineConfig(
+        max_slots=4, max_input_length=640, max_output_length=64,
+        prefill_buckets=(64, 128, 256, 640), page_size=32,
+        dtype="float32", max_queue=64, spec_decode=True))
+    try:
+        chat = bench.run_chat_bench(eng, n_turns=4, system_len=96,
+                                    user_len=24, reply_len=48,
+                                    warmup=False)
+    finally:
+        eng.stop()
+    spec = chat["spec"]
+    assert spec is not None and spec["verify_rounds"] > 0
+    assert set(spec) == set(load_schema()["spec"])
+    assert spec["tokens_per_step"] > 1.5, (
+        f"speculative multiplier too low on the copy-heavy chat mix: "
+        f"{spec}")

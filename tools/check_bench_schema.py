@@ -80,6 +80,15 @@ def validate_result(result: dict, schema: dict | None = None) -> None:
         sub = result.get(section)
         if isinstance(sub, dict):
             _check_types(section, sub, schema[section], errors)
+    # Speculative-decoding blocks: chat and openloop carry a nested
+    # ``spec`` object (null when spec is off) — validated element-wise
+    # against the shared ``spec`` section so an acceptance-rate /
+    # tokens-per-step rename can't hide behind the obj type.
+    for section in ("chat", "openloop"):
+        sub = result.get(section)
+        if isinstance(sub, dict) and isinstance(sub.get("spec"), dict):
+            _check_types(f"{section}.spec", sub["spec"], schema["spec"],
+                         errors)
     # Open-loop sweep: each per-rate entry carries the SLO-attainment /
     # goodput headline fields — validated element-wise so a rename in
     # one rate's dict can't hide behind the list type.

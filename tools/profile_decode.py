@@ -37,10 +37,15 @@ from generativeaiexamples_tpu.utils.hbm import peak_bw as _peak_bw
 
 def profile_rung(params, cfg, *, slots: int, window: int, live_pages: int,
                  steps: int, page: int, dtype, kv_quant: bool,
-                 param_bytes: int, use_kernel: bool) -> dict:
+                 param_bytes: int, use_kernel: bool,
+                 verify_tokens: int = 8) -> dict:
     """Measure one slot-count rung: the full decode round and its
-    ablations (no-unembed, window=1), per step. Returns the per-rung
-    attribution dict the sweep artifact collects."""
+    ablations (no-unembed, window=1), per step, plus the speculative
+    VERIFY step (one ``verify_tokens``-position multi-token forward at
+    this decode occupancy — the dispatch unit of engine/spec_decode.py,
+    priced against the round budget via StepCostModel's
+    ``verify_ms_per_token``). Returns the per-rung attribution dict the
+    sweep artifact collects."""
     from generativeaiexamples_tpu.models import llama
 
     B, W, K = slots, window, steps
@@ -105,6 +110,44 @@ def profile_rung(params, cfg, *, slots: int, window: int, live_pages: int,
              kv_live // max(live_pages, 1))
     peak = _peak_bw(jax.local_devices()[0])
     achieved = (param_bytes + kv_live) / full * 1e3  # bytes/s
+
+    # Speculative verify step: S = verify_tokens positions per slot in
+    # ONE forward (llama.apply_verify_paged — the jnp gather path the
+    # engine's verify rounds take on every backend). Measured at the
+    # same occupancy as the decode round above, so the scheduler's
+    # budget pricing compares like with like; per-token = the call
+    # divided by its slots x S scored positions (the unit
+    # StepCostModel.verify_cost_tokens ratios against
+    # prefill_ms_per_token).
+    S = verify_tokens
+    base_pos = max(0, live_pages * page - S - 2)
+
+    def verify_fn(params, cache, tok, pos):
+        positions = pos[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
+        tokens = jnp.broadcast_to(tok[:, None], (B, S))
+        wp = jnp.take_along_axis(table, positions // page, axis=1)
+        out, cache = llama.apply_verify_paged(
+            params, cfg, tokens, positions, cache, table, pos + S,
+            wp, positions % page)
+        nxt = jnp.argmax(out[:, -1], -1).astype(jnp.int32)
+        return cache, nxt
+
+    vfn = jax.jit(verify_fn, donate_argnums=(1,))
+    c, tok, posv = state["cache"], tokens0, jnp.full((B,), base_pos,
+                                                     jnp.int32)
+    for _ in range(2):
+        c, tok = vfn(params, c, tok, posv)
+    jax.block_until_ready(tok)
+    n = 6
+    t0 = time.perf_counter()
+    for _ in range(n):
+        c, tok = vfn(params, c, tok, posv)
+    jax.block_until_ready(tok)
+    verify_ms = (time.perf_counter() - t0) / n * 1e3
+    state["cache"] = c
+    print(f"[{B:>3} slots] verify x{S}   : {verify_ms:.2f} ms/step "
+          f"({verify_ms / (B * S):.4f} ms/token)")
+
     del state["cache"]  # free this rung's pool before the next builds
     return {
         "slots": B,
@@ -122,6 +165,11 @@ def profile_rung(params, cfg, *, slots: int, window: int, live_pages: int,
         # peak — the ladder whose 8→64 decay this round exists to close.
         "achieved_bw_gbps": round(achieved / 1e9, 1),
         "achieved_bw_fraction": round(achieved / peak, 3),
+        # Speculative verify cost at this occupancy: the S-position
+        # dispatch and its per-scored-token cost (StepCostModel input —
+        # prices verify rounds against the PR-6 token budget).
+        "verify_ms_per_step": round(verify_ms, 3),
+        "verify_ms_per_token": round(verify_ms / (B * S), 4),
     }
 
 
@@ -155,11 +203,13 @@ def main(json_path: str = "", slots_arg: str = ""):
     kv_quant = os.environ.get("PROF_KV_QUANT", "") == "int8"
     use_kernel = jax.default_backend() == "tpu"
     floor = param_bytes / _peak_bw(jax.local_devices()[0]) * 1e3
+    verify_tokens = int(os.environ.get("PROF_VERIFY_TOKENS", "8"))
 
     rungs = [profile_rung(
         params, cfg, slots=s, window=W, live_pages=live_pages, steps=K,
         page=page, dtype=dt, kv_quant=kv_quant, param_bytes=param_bytes,
-        use_kernel=use_kernel) for s in (sweep or [B])]
+        use_kernel=use_kernel, verify_tokens=verify_tokens)
+        for s in (sweep or [B])]
     r0 = rungs[0]
     print(f"=> unembed+argmax ~{r0['unembed_ms_per_step']:.2f} ms/step, "
           f"window stream ~{r0['window_stream_ms_per_step']:.2f} ms/step, "
@@ -213,21 +263,25 @@ def main(json_path: str = "", slots_arg: str = ""):
             "matmul_floor_ms_per_step": round(floor, 3),
             # Step-cost model inputs for the token-budget scheduler
             # (engine/scheduler.py): prefill cost per prompt token at
-            # the measured bucket.
+            # the measured bucket, and the verify-round geometry the
+            # per-rung verify_ms_per_token was measured at.
             "prefill_bucket_tokens": S,
             "prefill_ms_per_token": round(prefill_ms_tok, 4),
+            "verify_positions": verify_tokens,
         }
         if sweep:
             # Sweep shape: one attribution entry per slot rung. The
             # single-rung keys the scheduler's StepCostModel reads
-            # (full_ms_per_step, prefill_ms_per_token) are mirrored at
-            # top level from the FIRST rung so an _rNN sweep artifact
-            # still feeds the cost model unchanged.
+            # (full_ms_per_step, verify_ms_per_token, slots,
+            # prefill_ms_per_token) are mirrored at top level from the
+            # FIRST rung so an _rNN sweep artifact still feeds the cost
+            # model unchanged.
             artifact = dict(
                 shared,
                 slots_sweep=sweep,
                 slots=r0["slots"],
                 full_ms_per_step=r0["full_ms_per_step"],
+                verify_ms_per_token=r0["verify_ms_per_token"],
                 rungs=rungs,
             )
         else:
