@@ -863,13 +863,42 @@ def pipeline_snapshot(stats: dict) -> dict:
     }
 
 
+def rounds_snapshot(engine) -> dict:
+    """Round-level attribution for the bench JSON, sourced from the
+    engine's ROUND RECORDER (obs/rounds.py) instead of ad-hoc bench
+    timers: the same per-round records /debug/rounds serves, aggregated
+    over the ring. Complements pipeline_snapshot (which reads the
+    engine's cumulative stage counters): this is the per-round
+    distribution — device time per round, tokens per round, interleave
+    share, live bandwidth estimate, and how far measured rounds drifted
+    from the step-cost model. Scoped to THIS engine's records — the
+    recorder is process-global, and a degraded-rung or sweep engine's
+    rounds must not pollute the measured engine's block."""
+    agg = engine.rounds.snapshot(
+        limit=0, engine_tag=engine.engine_tag)["aggregates"]
+    stats = engine.stats
+    return {
+        "rounds_completed": int(stats.get("rounds_completed", 0)),
+        "window_rounds": int(agg.get("rounds_completed", 0)),
+        "avg_round_ms": float(agg.get("avg_round_ms", 0.0)),
+        "avg_device_ms": float(agg.get("avg_device_ms", 0.0)),
+        "p50_device_ms": float(agg.get("p50_device_ms", 0.0)),
+        "tokens_per_sec": float(agg.get("tokens_per_sec", 0.0)),
+        "interleaved_share": float(agg.get("interleaved_share", 0.0)),
+        "avg_bw_util": float(agg.get("avg_bw_util", 0.0)),
+        "drift_ratio": float(stats.get("sched_cost_drift_ratio", 0.0)),
+        "budget_recalibrations": int(
+            stats.get("sched_budget_recalibrations", 0)),
+    }
+
+
 def assemble_result(*, kind, model, headline, engine_p50, engine_p99, tput,
                     achieved_bw, bw_util, bw_steady, chat, e2e_p50,
                     e2e_dist, e2e_breakdown, pipeline, quant, kv_quant,
                     weights, prompt_len, out_len, slots, steps_per_round,
                     kv_pool_pages, device, rtt_ms, n_devices,
                     bench_seconds, e2e_tps_p50=None, openloop=None,
-                    fleet=None, capacity=None) -> dict:
+                    fleet=None, capacity=None, rounds=None) -> dict:
     """The bench's single output contract. Every field name here is
     pinned by tools/bench_schema.json (validated at emit time AND by the
     tier-1 suite, tests/test_bench_schema.py) so a rename fails fast
@@ -902,6 +931,10 @@ def assemble_result(*, kind, model, headline, engine_p50, engine_p99, tput,
         # Harvest/dispatch overlap: the readback wait now runs on the
         # harvest worker, concurrent with dispatch (pipeline_snapshot)
         "engine_pipeline": pipeline,
+        # Round telemetry (obs/rounds.py): per-round attribution from
+        # the engine's round recorder — device ms per round, interleave
+        # share, live bandwidth estimate, model-vs-measured drift
+        "engine_rounds": rounds,
         # Open-loop Poisson-arrival scenario (BENCH_ARRIVAL_RPS sweep):
         # SLO attainment + goodput under offered load — null when the
         # sweep is not requested (closed-loop-only runs keep their
@@ -1289,6 +1322,7 @@ def main() -> None:
         # Cumulative over every scenario above — the overlap summary is
         # about pipeline behavior, not one workload's magnitude.
         pipeline = pipeline_snapshot(engine.stats)
+        rounds = rounds_snapshot(engine)
     finally:
         engine.stop()
 
@@ -1354,7 +1388,7 @@ def main() -> None:
         chat=chat, e2e_p50=e2e_p50, e2e_dist=e2e_dist,
         e2e_breakdown=e2e_breakdown, e2e_tps_p50=e2e_tps_p50,
         pipeline=pipeline, openloop=openloop, fleet=fleet,
-        capacity=capacity,
+        capacity=capacity, rounds=rounds,
         quant=quant, kv_quant=engine.cfg.kv_quant or None,
         weights=("real" if os.environ.get("BENCH_MODEL_PATH")
                  else "random-init"),

@@ -288,7 +288,9 @@ def create_app(example: BaseExample,
             logger.exception("ingest failed for %s", filename)
             return error_response(500, "ingest_error",
                                   f"ingest failed: {exc}", rid)
-        obs_metrics.REGISTRY.counter("documents_ingested_total").inc()
+        obs_metrics.REGISTRY.counter(
+            "documents_ingested_total",
+            "documents ingested via /uploadDocument").inc()
         return web.json_response({"filename": filename, "status": "ingested"})
 
     @instrumented("generate_answer")
@@ -539,9 +541,17 @@ def create_app(example: BaseExample,
         # timelines (obs/flight.py; ?limit= caps the completed list).
         return obs_flight.debug_requests_response(request)
 
+    async def debug_rounds(request: web.Request) -> web.Response:
+        # Engine-level round telemetry: per-round plan + execution
+        # records and rolling aggregates (obs/rounds.py; ?limit= caps
+        # the record list).
+        from ..obs import rounds as obs_rounds
+        return obs_rounds.debug_rounds_response(request)
+
     app.router.add_get("/health", health)
     app.router.add_get("/metrics", metrics_endpoint)
     app.router.add_get("/debug/requests", debug_requests)
+    app.router.add_get("/debug/rounds", debug_rounds)
     app.router.add_post("/uploadDocument", upload_document)
     app.router.add_post("/generate", generate_answer)
     app.router.add_post("/documentSearch", document_search)
@@ -575,6 +585,14 @@ def main(argv: Optional[list[str]] = None) -> None:
             obs_tracing.set_enabled(True)
     except Exception:  # noqa: BLE001 — config problems must not kill boot
         logger.debug("tracing config not applied", exc_info=True)
+
+    # Pid file under the run dir (GAIE_RUN_DIR, default under /tmp) —
+    # the sanctioned replacement for launcher-side `echo $! > server.pid`
+    # debris at the repo root.
+    from ..utils.logging import write_pid_file
+    pid_path = write_pid_file(f"chain-server-{args.port}")
+    if pid_path:
+        logger.info("pid file: %s", pid_path)
 
     example_cls = discover_example(args.example)
     example = example_cls()

@@ -60,6 +60,7 @@ from ..models import llama
 from ..models.configs import LlamaConfig
 from ..models.tokenizer import Tokenizer
 from ..obs import flight as obs_flight
+from ..obs import rounds as obs_rounds
 from ..obs.tracing import record_stage
 from ..ops.fused_sampler import (choose_tile, fused_unembed_sample,
                                  fused_verify_sample,
@@ -71,12 +72,23 @@ from ..parallel.sharding import (llama_param_specs, paged_kv_cache_spec,
                                  shard_params)
 from ..utils import faults
 from ..utils.errors import ConfigError, EngineError, SchedulerFullError
+from ..utils.hbm import peak_bw
+from ..utils.logging import get_logger, log_event
 from .detokenizer import IncrementalDetokenizer, StopWordTrap
 from .prefix_cache import PrefixCache, hash_blocks, usable_prefix_tokens
 from .sampling_params import SamplingParams
-from .scheduler import PrefillJob, StepCostModel, TokenBudgetScheduler
+from .scheduler import (OnlineCalibrator, PrefillJob, StepCostModel,
+                        TokenBudgetScheduler, online_calib_enabled)
 from .spec_decode import (AdaptiveDraftController, PromptLookupDrafter,
                           SpecConfig, spec_enabled)
+
+
+logger = get_logger(__name__)
+
+# Short per-engine tag stamped on round-telemetry records: multi-engine
+# processes (the fleet bench, tests) share the process-global round ring,
+# and the tag is what tells their rounds apart in /debug/rounds.
+_ENGINE_TAGS = itertools.count()
 
 
 def _ceil_div(a: int, b: int) -> int:
@@ -146,6 +158,14 @@ _STATS_TEMPLATE = {
     "spec_verify_rounds": 0,
     "spec_verify_tokens": 0,
     "spec_verify_slot_steps": 0,
+    # Round telemetry (obs/rounds.py): engine rounds whose plan AND
+    # every harvested device output have been recorded — the flight-
+    # recorder-style per-round records behind GET /debug/rounds.
+    "rounds_completed": 0,
+    # Online cost calibration (engine/scheduler.py OnlineCalibrator):
+    # times recalibrate() actually moved the derived round budget —
+    # 0 forever when SCHED_ONLINE_CALIB=0 or the budget is pinned.
+    "sched_budget_recalibrations": 0,
 }
 
 
@@ -157,7 +177,8 @@ def engine_stat_keys() -> tuple[str, ...]:
     from .prefix_cache import CacheStats
     return (tuple(_STATS_TEMPLATE)
             + ("dispatch_queue_depth", "sched_prefill_share",
-               "spec_acceptance_rate", "spec_tokens_per_step")
+               "spec_acceptance_rate", "spec_tokens_per_step",
+               "sched_cost_drift_ratio")
             + tuple(CacheStats().snapshot()) + ("prefix_cache_pages",))
 
 
@@ -604,14 +625,50 @@ class Engine:
         # default, mirroring the BENCH_* knob convention.
         env_budget = os.environ.get("SCHED_ROUND_BUDGET_TOKENS", "")
         env_chunk = os.environ.get("SCHED_PREFILL_CHUNK_TOKENS", "")
+        # Online cost calibration (SCHED_ONLINE_CALIB, default on): the
+        # artifact prior seeds the model; measured per-round costs from
+        # the round recorder blend it toward this deployment's reality
+        # and recalibrate() re-derives the budget between rounds.
+        # =0 pins the static model — the pre-calibration behavior.
+        cost_prior = StepCostModel.load()
+        self._calib = (OnlineCalibrator(cost_prior)
+                       if online_calib_enabled() else None)
         self._sched = TokenBudgetScheduler(
-            StepCostModel.load(), page_size=page,
+            cost_prior, page_size=page,
             steps_per_round=cfg.steps_per_round,
             round_budget_tokens=(int(env_budget) if env_budget
                                  else cfg.sched_round_budget_tokens),
             chunk_tokens=(int(env_chunk) if env_chunk
                           else cfg.sched_prefill_chunk_tokens),
-            max_one_shot_tokens=self._buckets[-1])
+            max_one_shot_tokens=self._buckets[-1],
+            calibrator=self._calib)
+        # Round telemetry (obs/rounds.py): per-round plan+execution
+        # records behind GET /debug/rounds, the engine_round_* metric
+        # surface, and the calibrator's evidence. Override-able like the
+        # flight recorder (tests install private instances).
+        self._rounds_override: Optional[obs_rounds.RoundRecorder] = None
+        self._engine_tag = f"e{next(_ENGINE_TAGS)}"
+        # Inputs of the per-round HBM-traffic estimate: weight bytes
+        # streamed once per decode step, KV page bytes per touched page,
+        # and the chip's peak bandwidth (0 on CPU — no roofline there).
+        self._param_bytes = sum(
+            int(x.nbytes) for x in jax.tree.leaves(self.params))
+        try:
+            dev0 = (self.mesh.devices.flat[0] if self.mesh is not None
+                    else jax.local_devices()[0])
+            self._hbm_peak = (0.0 if dev0.platform == "cpu"
+                              else peak_bw(dev0))
+        except Exception:  # noqa: BLE001 — telemetry must not block build
+            self._hbm_peak = 0.0
+        # Model-vs-measured drift: EWMA of (round wall / modeled round
+        # cost), updated per completed round on the harvest thread.
+        # Tracked even with calibration pinned off — drift against a
+        # deliberately static model is exactly the regression signal.
+        self._drift_ratio: Optional[float] = None
+        self._drift_dump_ratio = float(
+            os.environ.get("ROUND_DRIFT_DUMP_RATIO", "8") or 0)
+        self._slow_round_ms = float(
+            os.environ.get("ROUND_SLOW_MS", "0") or 0)
         # Harvest pipeline: the scheduler enqueues each dispatched
         # program's output (first-token scalars, decode-round token
         # blocks) onto ``_harvest_q`` in dispatch order; the harvest
@@ -1073,6 +1130,24 @@ class Engine:
         self._flight_override = recorder
 
     @property
+    def rounds(self) -> obs_rounds.RoundRecorder:
+        """Round recorder in use: the process-global one unless a
+        private instance was installed (tests) — same resolution rule
+        as the flight recorder."""
+        return self._rounds_override or obs_rounds.RECORDER
+
+    @rounds.setter
+    def rounds(self, recorder: obs_rounds.RoundRecorder) -> None:
+        self._rounds_override = recorder
+
+    @property
+    def engine_tag(self) -> str:
+        """This engine's tag on its round-telemetry records — the
+        ``?engine=`` filter value for ``/debug/rounds`` in multi-engine
+        processes, and what bench's per-engine aggregation scopes by."""
+        return self._engine_tag
+
+    @property
     def stats(self) -> dict[str, float]:
         with self._stats_lock:
             out = dict(self._stats)
@@ -1097,6 +1172,13 @@ class Engine:
             round(out["spec_verify_tokens"]
                   / out["spec_verify_slot_steps"], 4)
             if out["spec_verify_slot_steps"] else 0.0)
+        # Model-vs-measured drift over completed rounds: 1.0 = the
+        # step-cost model predicts round time; >1 = rounds run slower
+        # than planned (regression, or a stale artifact prior); 0.0
+        # until the first round completes.
+        drift = self._drift_ratio
+        out["sched_cost_drift_ratio"] = (round(drift, 4)
+                                         if drift is not None else 0.0)
         cache = self._prefix_cache
         if cache is not None:
             # Cache counters are written only on the serve-loop thread;
@@ -1652,11 +1734,18 @@ class Engine:
                     params, mcfg, tokens, positions, state["cache"],
                     row_win, valid[None], start // self.cfg.page_size,
                     with_logits=False)
+                # Round-telemetry completion marker: a scalar OUTPUT
+                # that data-depends on the chunk's paged prefill, so a
+                # host readback of it blocks until this program has
+                # executed. Its buffer is NOT part of the donated state
+                # dict — it survives the next dispatch, unlike any ref
+                # into the returned state (which donation invalidates).
+                marker = cache["k"][0, 0, 0, 0, 0]
                 return dict(state,
                             cache=self._pin_cache(cache),
                             seen=self._chunk_seen(state, tokens, start,
                                                   valid, slot, mode,
-                                                  *seed))
+                                                  *seed)), marker
 
             fn = jax.jit(extend, donate_argnums=(0,))
             self._chunk_fns[key] = fn
@@ -2352,6 +2441,14 @@ class Engine:
                     record_stage("loop_drain", t1 - t0)
                 self._pull_pending()
                 did_work |= self._cull_backlog()
+                # Online calibration: fold any new measured-round
+                # evidence into the planning model BEFORE this round is
+                # planned (cheap version check; no-op when pinned).
+                if self._calib is not None and self._sched.recalibrate():
+                    with self._stats_lock:
+                        self._stats["sched_round_budget_tokens"] = \
+                            self._sched.round_budget_tokens
+                        self._stats["sched_budget_recalibrations"] += 1
                 plan = self._plan_round()
                 did_work |= self._execute_plan(plan)
                 self._guard_live()
@@ -2430,8 +2527,22 @@ class Engine:
                 faults.inject("engine.harvest")  # chaos: readback failure
                 kind = item[0]
                 t0 = time.monotonic()
+                if kind == "mark":
+                    # A prefill-only round's completion marker: the
+                    # scalar's readback lands when the round's last
+                    # chunk has executed on the device — the execution
+                    # half of its RoundRecord completes here.
+                    _, rec, marker = item
+                    np.asarray(marker)  # blocks off-thread
+                    wait = time.monotonic() - t0
+                    if self._gen != gen:
+                        return
+                    self.rounds.complete_part(rec,
+                                              harvest_wait_ms=wait * 1e3)
+                    self._wake.set()
+                    continue
                 if kind == "first":
-                    _, req, first_tok = item
+                    _, req, first_tok, rec = item
                     arr = np.asarray(first_tok)  # blocks off-thread
                     wait = time.monotonic() - t0
                     record_stage("engine_first_readback", wait)
@@ -2442,6 +2553,7 @@ class Engine:
                         tl.stage("engine_first_readback", wait)
                     if self._gen != gen:
                         return
+                    emitted_first = not req.done
                     if not req.done:
                         if arr.ndim == 0:
                             self._emit_token(req, int(arr))
@@ -2451,12 +2563,14 @@ class Engine:
                             req.stream.source_ids = [int(x)
                                                      for x in arr[2:]]
                             self._emit_token(req, int(arr[0]))
+                    self.rounds.first_token(rec, wait_ms=wait * 1e3,
+                                            counted=emitted_first)
                 else:
                     if kind == "verify":
-                        _, members, toks_dev, acc_dev, drafted = item
+                        _, members, toks_dev, acc_dev, drafted, rec = item
                         accs = np.asarray(acc_dev)   # blocks off-thread
                     else:
-                        _, members, toks_dev = item
+                        _, members, toks_dev, rec = item
                         accs = drafted = None
                     toks = np.asarray(toks_dev)  # (K, B); blocks off-thread
                     wait = time.monotonic() - t0
@@ -2489,9 +2603,14 @@ class Engine:
                         tl = members[slot].stream.timeline
                         if tl is not None:
                             tl.event("decode_round", n)
+                    accepted = 0
                     if kind == "verify":
-                        self._finish_verify(members, accs, drafted,
-                                            emitted)
+                        accepted = self._finish_verify(members, accs,
+                                                       drafted, emitted)
+                    self.rounds.complete_part(
+                        rec, tokens=sum(emitted.values()),
+                        spec_accepted=accepted,
+                        harvest_wait_ms=wait * 1e3)
                     with self._pipe_lock:
                         # Guarded by the generation check just above: a
                         # worker disowned during the readback must not
@@ -2509,7 +2628,7 @@ class Engine:
             self._wake.set()
 
     def _finish_verify(self, members: dict, accs, drafted: dict,
-                       emitted: dict) -> None:
+                       emitted: dict) -> int:
         """Harvest-side bookkeeping of one verify round: speculative
         stats, per-request flight-recorder draft/accept counts, the
         adaptive-K controllers, and the ``proj_pos`` re-anchor (the
@@ -2538,6 +2657,7 @@ class Engine:
             self._stats["spec_accepted_tokens"] += accept_total
             self._stats["spec_verify_tokens"] += sum(emitted.values())
             self._stats["spec_verify_slot_steps"] += len(emitted)
+        return accept_total
 
     def _pull_pending(self) -> bool:
         """Drain the thread-safe intake queue into the scheduler's
@@ -2715,22 +2835,55 @@ class Engine:
         """Dispatch one round plan: the decode round first (the latency-
         critical work for every armed stream), then the granted prefill
         chunks. Stops admitting on pool backpressure; counts the round
-        as interleaved when both kinds of work actually dispatched."""
+        as interleaved when both kinds of work actually dispatched.
+
+        Round telemetry: the plan opens a RoundRecord (scheduler-side
+        half), each dispatch fills its execution fields, and the harvest
+        worker completes it — a prefill-only round gets a completion
+        MARKER in the harvest queue (a scalar output of the last chunk's
+        program, so its readback lands exactly when the chunk's device
+        work finishes)."""
+        rec = None
+        if plan.decode_steps or plan.chunks:
+            rec = self.rounds.begin(
+                engine_tag=self._engine_tag,
+                budget_tokens=plan.budget_tokens,
+                decode_steps=plan.decode_steps,
+                decode_cost_tokens=plan.decode_cost_tokens,
+                active_decodes=plan.active_decodes,
+                kind=("verify" if (plan.decode_steps
+                                   and self._draft_plan is not None)
+                      else "decode" if plan.decode_steps else "prefill"),
+                on_complete=self._on_round_complete)
+        try:
+            return self._execute_plan_inner(plan, rec)
+        except BaseException:
+            # The round died mid-dispatch (fault injection, _StaleLoop
+            # from a reset, a device error): an unsealed record would
+            # sit in the ring as not-done debris forever — drop it. A
+            # SEALED record's fate rides the harvest pipeline as usual.
+            if rec is not None and not rec._sealed:
+                self.rounds.discard(rec)
+            raise
+
+    def _execute_plan_inner(self, plan, rec) -> bool:
         did = False
         decoded = False
         t0 = time.monotonic()
         if plan.decode_steps:
             if self._draft_plan is not None:
-                decoded = self._dispatch_verify(self._draft_plan)
+                decoded = self._dispatch_verify(self._draft_plan, rec)
                 self._draft_plan = None
             else:
-                decoded = self._dispatch_round(plan.decode_steps)
+                decoded = self._dispatch_round(plan.decode_steps, rec)
             if decoded:
                 did = True
                 self._bump("sched_decode_tokens", plan.decode_cost_tokens)
                 record_stage("loop_dispatch", time.monotonic() - t0)
         t1 = time.monotonic()
         prefilled = 0
+        grants: list[tuple[str, int]] = []
+        marker = None
         for key, grant in plan.chunks:
             req: _Request = key
             if req.slot < 0:
@@ -2741,17 +2894,117 @@ class Engine:
                     continue
                 if not ok:         # pool backpressure: stop admitting
                     break
-            n = self._advance_prefill(req, grant)
+            n, m = self._advance_prefill(req, grant, rec)
             self._guard_live()
             if n:
                 did = True
                 prefilled += n
+                grants.append((req.stream.request_id, n))
+                if m is not None:
+                    marker = m
+                if rec is not None:
+                    # Prefill traffic estimate: each chunk streams the
+                    # weights once and writes its tokens' KV.
+                    rec.hbm_bytes += self._param_bytes \
+                        + n * self._kv_bytes_per_token()
         if prefilled:
             self._bump("sched_prefill_tokens", prefilled)
             record_stage("loop_admit", time.monotonic() - t1)
             if decoded:
                 self._bump("sched_interleaved_rounds")
+        if rec is not None:
+            parts = int(decoded)
+            if prefilled and marker is not None:
+                # Completion marker: a scalar OUTPUT of the last chunk's
+                # program (never part of the donated state). The harvest
+                # worker's np.asarray on it blocks until that program —
+                # and, the device stream being FIFO, every earlier chunk
+                # of this round — has executed: the honest end-of-round
+                # signal for prefill work that otherwise produces no
+                # readback until a slot arms.
+                parts += 1
+                self._harvest_q.put(("mark", rec, marker))
+            if parts == 0:
+                self.rounds.discard(rec)
+            else:
+                if not decoded:
+                    rec.kind = "prefill"
+                elif prefilled:
+                    rec.kind = "mixed" if rec.kind == "decode" \
+                        else rec.kind
+                self.rounds.seal(
+                    rec, parts=parts, prefill_tokens=prefilled,
+                    grants=grants,
+                    modeled_ms=self._modeled_round_ms(
+                        rec, plan.decode_steps if decoded else 0,
+                        prefilled))
         return did
+
+    def _modeled_round_ms(self, rec, decode_steps: int,
+                          prefill_tokens: int) -> float:
+        """What the live step-cost model predicts this round should
+        take — the denominator of the drift ratio. Captured at seal
+        time so a later recalibration cannot rewrite history."""
+        cost = self._sched.cost
+        modeled = 0.0
+        if decode_steps:
+            if rec.verify_positions:
+                per = cost.verify_ms_per_token or cost.prefill_ms_per_token
+                modeled += rec.verify_positions * per
+            else:
+                modeled += cost.decode_round_ms(decode_steps)
+        modeled += prefill_tokens * cost.prefill_ms_per_token
+        return modeled
+
+    def _on_round_complete(self, rec) -> None:
+        """Harvest-thread completion callback for one round record:
+        bandwidth estimate, drift accounting, calibrator feed, metric
+        mirror, slow-round dump, and the retrospective OTel span.
+        Observability — never raises into the harvest worker."""
+        try:
+            if self._hbm_peak > 0 and rec.device_ms > 0:
+                rec.bw_util = rec.hbm_bytes / (rec.device_ms / 1e3) \
+                    / self._hbm_peak
+            ratio = (rec.round_ms / rec.modeled_ms
+                     if rec.modeled_ms > 0 else 0.0)
+            rec.drift_ratio = ratio
+            if ratio > 0:
+                prev = self._drift_ratio
+                self._drift_ratio = (ratio if prev is None
+                                     else prev + 0.2 * (ratio - prev))
+            # Calibration: only PURE rounds are attributable (a mixed
+            # round's device time cannot be split honestly).
+            if self._calib is not None:
+                if rec.kind == "decode" and not rec.prefill_tokens:
+                    self._calib.observe_decode(rec.decode_steps,
+                                               rec.device_ms)
+                elif rec.kind == "verify" and not rec.prefill_tokens:
+                    self._calib.observe_verify(rec.verify_positions,
+                                               rec.device_ms)
+                elif rec.kind == "prefill":
+                    self._calib.observe_prefill(rec.prefill_tokens,
+                                                rec.device_ms)
+            self._bump("rounds_completed")
+            obs_rounds.record_round_metrics(rec, self._drift_ratio)
+            slow = (self._slow_round_ms
+                    and rec.round_ms > self._slow_round_ms)
+            drifted = (self._drift_dump_ratio and ratio
+                       and ratio > self._drift_dump_ratio
+                       # micro-rounds drift wildly on noise alone; only
+                       # dump when the model predicted measurable work
+                       and rec.modeled_ms >= 0.25)
+            if slow or drifted:
+                obs_rounds.count_slow_dump()
+                log_event(logger, "slow_round",
+                          reason=("slow" if slow else "drift"),
+                          drift_ratio=round(ratio, 3),
+                          drift_threshold=self._drift_dump_ratio,
+                          slow_ms_threshold=self._slow_round_ms,
+                          round=rec.to_dict())
+            obs_rounds.emit_round_span(rec)
+        except Exception:  # noqa: BLE001 — telemetry must never raise
+            logger.debug("round completion accounting failed",
+                         exc_info=True)
 
     def _begin_prefill(self, req: _Request):
         """Admission half 1: allocate the slot and pages, take prefix-
@@ -2888,17 +3141,21 @@ class Engine:
         shapes, so interleaving adds no new compile geometries."""
         return self._bucket_for(n)
 
-    def _advance_prefill(self, req: _Request, grant: int) -> int:
+    def _advance_prefill(self, req: _Request, grant: int,
+                         rec=None) -> tuple[int, Optional[object]]:
         """Admission half 2, run once per round plan: dispatch ONE
         prefill chunk of up to ``grant`` tokens (bucket-shape padded).
         The final chunk arms the slot and hands the first token to the
-        harvest worker. Returns the prompt tokens computed (0 = nothing
-        dispatched). Short cold prompts whose whole extent fits the
-        grant keep the ONE-dispatch fused prefill+insert path — the
-        TTFT-critical case is still a single program."""
+        harvest worker. Returns ``(tokens computed, completion
+        marker)`` — the marker is a device scalar that data-depends on
+        the dispatched program (round telemetry reads it to time the
+        round's end); ``(0, None)`` when nothing dispatched. Short cold
+        prompts whose whole extent fits the grant keep the ONE-dispatch
+        fused prefill+insert path — the TTFT-critical case is still a
+        single program."""
         sp = req.params
         if req.rag is not None:
-            return self._dispatch_rag(req)
+            return self._dispatch_rag(req, rec)
         pf = req.pf
         if req.pf_pos > pf["start_tok"]:
             # Between-chunk aborts only: an admission that began keeps
@@ -2908,14 +3165,14 @@ class Engine:
             # sinking further rounds into an unwanted answer.
             if req.stream.cancelled:
                 self._abort_prefill(req, "cancelled")
-                return 0
+                return 0, None
             if req.deadline_t is not None \
                     and time.monotonic() > req.deadline_t:
                 # Counted as a mid-flight deadline stop (the request DID
                 # consume compute, unlike a deadline_queue drop).
                 self._bump("deadline_stops")
                 self._abort_prefill(req, "deadline")
-                return 0
+                return 0, None
         total = len(req.prompt_ids)
         page = self.cfg.page_size
         n = min(grant, total - req.pf_pos, self._buckets[-1])
@@ -2923,7 +3180,7 @@ class Engine:
         if not final:
             n = (n // page) * page
             if n <= 0:
-                return 0
+                return 0, None
         faults.inject("engine.dispatch")  # chaos: slow/failed prefill
         t_chunk = time.monotonic()
         key = pf["key"]
@@ -2945,6 +3202,7 @@ class Engine:
                 req.greedy)
             self._guard_live()
             self._state = new_state
+            marker = first_tok
         else:
             C = self._chunk_pad(n)
             chunk = req.prompt_ids[req.pf_pos:req.pf_pos + n] \
@@ -2957,14 +3215,16 @@ class Engine:
             self._guard_live()
             if not final:
                 if seeding:
-                    new_state = self._chunk_extend_fn(pf["window"], "seed")(
+                    new_state, marker = self._chunk_extend_fn(
+                        pf["window"], "seed")(
                         self._state, self.params, toks, start, valid,
                         jnp.int32(req.slot), pf["row_win"], pf["seed"])
                 else:
                     mode = ("replace"
                             if req.pf_pos == 0 and pf["start_tok"] == 0
                             else "accum")
-                    new_state = self._chunk_extend_fn(pf["window"], mode)(
+                    new_state, marker = self._chunk_extend_fn(
+                        pf["window"], mode)(
                         self._state, self.params, toks, start, valid,
                         jnp.int32(req.slot), pf["row_win"])
                 first_tok = None
@@ -2981,6 +3241,7 @@ class Engine:
                     args = args + (pf["seed"],)
                 new_state, first_tok = self._chunk_final_fn(
                     pf["window"], req.greedy, seeding)(*args)
+                marker = first_tok
             self._guard_live()
             self._state = new_state
         dt = time.monotonic() - t_chunk
@@ -2992,14 +3253,17 @@ class Engine:
             tl.stage("engine_prefill_chunk", dt)
         req.pf_pos += n
         if final:
-            self._arm_slot(req, first_tok)
-        return n
+            self._arm_slot(req, first_tok, rec)
+        return n, marker
 
-    def _arm_slot(self, req: _Request, first_tok) -> None:
+    def _arm_slot(self, req: _Request, first_tok, rec=None) -> None:
         """Prefill complete: publish cache blocks, mark the slot armed
         for decode rounds, and hand the first-token readback to the
         harvest worker (its wait overlaps the decode rounds dispatched
-        right after — FIFO order in the queue keeps it ahead of them)."""
+        right after — FIFO order in the queue keeps it ahead of them).
+        ``rec``: the round record of the ARMING round — the harvest
+        worker attributes the first-token readback wait (and the first
+        token itself) to it."""
         pf = req.pf
         self._register_prefix(req, pf["hashes"], pf["k_use"])
         record_stage("engine_admit_dispatch", pf["dispatch_s"])
@@ -3019,13 +3283,15 @@ class Engine:
             pass
         req.pf = None
         req.prefill_done = True
-        self._harvest_q.put(("first", req, first_tok))
+        self._harvest_q.put(("first", req, first_tok, rec))
 
-    def _dispatch_rag(self, req: _Request) -> int:
+    def _dispatch_rag(self, req: _Request, rec=None
+                      ) -> tuple[int, Optional[object]]:
         """Fused-RAG admission: retrieval + assembly + prefill happen in
         ONE device program, so the dispatch is atomic — the scheduler
         charges the whole assembled bucket against the round budget (a
-        grant can't split an on-device assembly)."""
+        grant can't split an on-device assembly). Returns ``(tokens,
+        completion marker)`` like ``_advance_prefill``."""
         sp = req.params
         pf = req.pf
         faults.inject("engine.dispatch")  # chaos: slow/failed prefill
@@ -3048,16 +3314,18 @@ class Engine:
         self._guard_live()
         self._state = new_state
         pf["dispatch_s"] += time.monotonic() - t0
-        self._arm_slot(req, first_tok)
-        return fused.spec.bucket
+        self._arm_slot(req, first_tok, rec)
+        return fused.spec.bucket, first_tok
 
-    def _dispatch_round(self, steps: int) -> bool:
+    def _dispatch_round(self, steps: int, rec=None) -> bool:
         """Dispatch one decode round of ``steps`` fused steps (the plan
         right-sized them against the power-of-two ladder), or decline
         (False) when no ARMED slot still needs tokens — slots mid-
         chunked-prefill are excluded: they are inactive on the device
         until their final chunk arms them, so a round over them would be
-        pure masked work."""
+        pure masked work. ``rec``: this round's telemetry record; the
+        dispatched program's harvest item carries it so the harvest
+        worker can complete the execution half."""
         members = {s: r for s, r in self._slots.items() if r.prefill_done}
         need_steps = max((r.extent - r.proj_pos for r in
                           members.values()), default=0)
@@ -3102,6 +3370,19 @@ class Engine:
             toks.copy_to_host_async()
         except Exception:  # noqa: BLE001 — optional fast path
             pass
+        if rec is not None:
+            # Execution estimate for the round record: live pages each
+            # step must read (per-slot ceil(pos/page), pre-advance) and
+            # the HBM traffic they plus the weight stream imply.
+            page = self.cfg.page_size
+            pages_per_step = sum(
+                _ceil_div(max(1, r.proj_pos + 1), page)
+                for r in members.values())
+            rec.decode_slots = len(members)
+            rec.pages_touched += pages_per_step * steps
+            rec.hbm_bytes += steps * (
+                self._param_bytes
+                + pages_per_step * page * self._kv_bytes_per_token())
         for req in members.values():
             req.proj_pos = min(req.proj_pos + steps, req.extent)
         with self._pipe_lock:
@@ -3110,11 +3391,11 @@ class Engine:
         with self._stats_lock:
             if depth > self._stats["dispatch_depth_peak"]:
                 self._stats["dispatch_depth_peak"] = depth
-        self._harvest_q.put(("round", members, toks))
+        self._harvest_q.put(("round", members, toks, rec))
         self._bump("decode_steps", steps)
         return True
 
-    def _dispatch_verify(self, drafts: dict) -> bool:
+    def _dispatch_verify(self, drafts: dict, rec=None) -> bool:
         """Dispatch one speculative VERIFY round: every armed slot rides
         it (slots without proposals as plain 1-token rows), slots in
         ``drafts`` carry their prompt-lookup proposals. One model step,
@@ -3171,6 +3452,17 @@ class Engine:
             acc.copy_to_host_async()
         except Exception:  # noqa: BLE001 — optional fast path
             pass
+        if rec is not None:
+            pages_per_step = sum(
+                _ceil_div(max(1, r.proj_pos + 1), page)
+                for r in members.values())
+            rec.decode_slots = len(members)
+            rec.spec_drafted = sum(drafted.values())
+            rec.verify_positions = S * len(members)
+            rec.pages_touched += pages_per_step
+            rec.hbm_bytes += (
+                self._param_bytes
+                + pages_per_step * page * self._kv_bytes_per_token())
         for req in members.values():
             req.proj_pos = min(req.proj_pos + S, req.extent)
         with self._pipe_lock:
@@ -3179,7 +3471,7 @@ class Engine:
         with self._stats_lock:
             if depth > self._stats["dispatch_depth_peak"]:
                 self._stats["dispatch_depth_peak"] = depth
-        self._harvest_q.put(("verify", members, toks, acc, drafted))
+        self._harvest_q.put(("verify", members, toks, acc, drafted, rec))
         self._bump("decode_steps")
         self._bump("spec_verify_rounds")
         return True

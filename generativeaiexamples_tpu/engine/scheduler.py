@@ -43,7 +43,8 @@ import json
 import math
 import os
 import re
-from dataclasses import dataclass, field
+import threading
+from dataclasses import dataclass, field, replace
 from typing import Optional, Sequence
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(
@@ -164,6 +165,118 @@ def derive_round_budget(model: StepCostModel, steps_per_round: int,
     return pages * page_size
 
 
+def online_calib_enabled(default: bool = True) -> bool:
+    """``SCHED_ONLINE_CALIB`` gate for the online cost calibrator:
+    ``0``/``false`` pins the static (artifact/env/default) model; any
+    other value — and the unset default — enables calibration."""
+    raw = os.environ.get("SCHED_ONLINE_CALIB", "").strip().lower()
+    if not raw:
+        return default
+    return raw not in ("0", "false", "no", "off")
+
+
+class OnlineCalibrator:
+    """EWMA calibration of :class:`StepCostModel` from measured rounds.
+
+    The committed ``PROFILE_rNN.json`` prior is a point measurement from
+    whatever machine ran the profiler — the ROADMAP repeatedly flags the
+    CPU-labeled artifacts as "regenerate on chip". This class closes the
+    loop instead: the engine feeds it each completed round's *measured*
+    per-token costs (round telemetry, ``obs/rounds.py``), it keeps an
+    exponentially weighted moving average per cost component, and
+    :meth:`current` returns the model the scheduler should plan with —
+    the PRIOR blended toward the EWMA on a linear ramp
+    (``weight = min(1, n / warmup)``): the first observations only
+    nudge the model, and after ``warmup`` samples the measurement is
+    fully trusted (the EWMA itself keeps absorbing noise) — a badly
+    wrong artifact prior is fully displaced within a handful of rounds
+    instead of lingering as a 1/n tail.
+
+    Only *pure* rounds are attributable: a decode-only round measures
+    ``decode_step_ms``, a prefill-only round ``prefill_ms_per_token``, a
+    verify-only round ``verify_ms_per_token``. Mixed rounds are skipped
+    (their time cannot be split honestly) — under real traffic pure
+    rounds of every kind occur constantly, so the calibrator still sees
+    a steady diet.
+
+    Thread contract: ``observe_*`` run on the engine's harvest thread,
+    ``current``/``drift`` on the scheduler thread (and scrapes); a small
+    lock keeps each update atomic and the cached blended model
+    consistent.
+    """
+
+    def __init__(self, prior: StepCostModel, *, alpha: float = 0.25,
+                 warmup: int = 4):
+        self.prior = prior
+        self.alpha = float(alpha)
+        self.warmup = max(1, int(warmup))
+        self._lock = threading.Lock()
+        self._ewma: dict[str, float] = {}
+        self._n: dict[str, int] = {}
+        self._cached: StepCostModel = prior
+        self._dirty = False
+        self.version = 0    # bumps per observation; recalibrate() keys off it
+
+    def _observe(self, key: str, value: float) -> None:
+        if value <= 0 or not math.isfinite(value):
+            return
+        with self._lock:
+            prev = self._ewma.get(key)
+            self._ewma[key] = (value if prev is None
+                               else prev + self.alpha * (value - prev))
+            self._n[key] = self._n.get(key, 0) + 1
+            self._dirty = True
+            self.version += 1
+
+    def observe_decode(self, steps: int, device_ms: float) -> None:
+        """A pure decode round of ``steps`` fused steps took
+        ``device_ms`` of device time."""
+        if steps > 0:
+            self._observe("decode_step_ms", device_ms / steps)
+
+    def observe_prefill(self, tokens: int, device_ms: float) -> None:
+        """A prefill-only round computed ``tokens`` prompt tokens."""
+        if tokens > 0:
+            self._observe("prefill_ms_per_token", device_ms / tokens)
+
+    def observe_verify(self, positions: int, device_ms: float) -> None:
+        """A verify-only round scored ``positions`` slot-positions."""
+        if positions > 0:
+            self._observe("verify_ms_per_token", device_ms / positions)
+
+    def _blend(self, key: str, prior_value: float) -> float:
+        ewma = self._ewma.get(key)
+        if ewma is None:
+            return prior_value
+        w = min(1.0, self._n.get(key, 0) / self.warmup)
+        return (1.0 - w) * prior_value + w * ewma
+
+    def samples(self, key: str) -> int:
+        with self._lock:
+            return self._n.get(key, 0)
+
+    def current(self) -> StepCostModel:
+        """The blended model (cached; rebuilt only after new
+        observations). Falls back to the prior field-by-field until a
+        component has evidence."""
+        with self._lock:
+            if not self._dirty:
+                return self._cached
+            self._cached = replace(
+                self.prior,
+                decode_step_ms=self._blend("decode_step_ms",
+                                           self.prior.decode_step_ms),
+                prefill_ms_per_token=self._blend(
+                    "prefill_ms_per_token",
+                    self.prior.prefill_ms_per_token),
+                verify_ms_per_token=self._blend(
+                    "verify_ms_per_token",
+                    self.prior.verify_ms_per_token),
+                source=self.prior.source + "+online")
+            self._dirty = False
+            return self._cached
+
+
 @dataclass
 class PrefillJob:
     """One prefill the scheduler may advance this round.
@@ -226,8 +339,19 @@ class TokenBudgetScheduler:
                  steps_per_round: int,
                  round_budget_tokens: Optional[int] = None,
                  chunk_tokens: Optional[int] = None,
-                 max_one_shot_tokens: Optional[int] = None):
-        self.cost = cost
+                 max_one_shot_tokens: Optional[int] = None,
+                 calibrator: Optional[OnlineCalibrator] = None):
+        self._static_cost = cost
+        # Online calibration (``OnlineCalibrator``): when installed, the
+        # scheduler plans with the measured-blended model instead of the
+        # static artifact prior, and ``recalibrate()`` periodically
+        # re-derives the round budget from it. Precedence (see
+        # docs/scheduler.md): explicit env/config budget overrides are
+        # PINNED — calibration then only refines slack estimates and
+        # verify pricing, never the operator's chosen budget.
+        self.calibrator = calibrator
+        self._budget_pinned = round_budget_tokens is not None
+        self._chunk_pinned = chunk_tokens is not None
         self.page_size = page_size
         self.steps_per_round = steps_per_round
         if round_budget_tokens is not None:
@@ -255,6 +379,43 @@ class TokenBudgetScheduler:
         # case), WHO gets this round's page rotates across rounds so a
         # waiting job's admission is bounded by ~len(jobs) rounds.
         self._rr = 0
+        self._calib_version = -1   # last calibrator version recalibrated at
+
+    @property
+    def cost(self) -> StepCostModel:
+        """The model rounds are planned with: the calibrator's blended
+        model when online calibration is on, the static artifact/env
+        model otherwise."""
+        if self.calibrator is not None:
+            return self.calibrator.current()
+        return self._static_cost
+
+    def recalibrate(self) -> bool:
+        """Re-derive the round budget from the current (blended) cost
+        model. Called from the engine's scheduler thread between rounds;
+        cheap no-op unless the calibrator saw new evidence since the
+        last call. Explicitly pinned budgets (env/config) never move.
+        Returns True when the budget actually changed."""
+        if self.calibrator is None or self._budget_pinned:
+            return False
+        version = self.calibrator.version
+        if version == self._calib_version:
+            return False
+        self._calib_version = version
+        budget = derive_round_budget(self.cost, self.steps_per_round,
+                                     self.page_size)
+        if budget == self.round_budget_tokens:
+            return False
+        self.round_budget_tokens = budget
+        if not self._chunk_pinned:
+            # The chunk cap follows the budget (its documented default),
+            # still clamped to the largest dispatchable bucket.
+            cap = budget
+            if self.max_one_shot_tokens is not None:
+                cap = min(cap, max(self.page_size,
+                                   self.max_one_shot_tokens))
+            self.chunk_tokens = cap
+        return True
 
     # ------------------------------------------------------------ slack
 

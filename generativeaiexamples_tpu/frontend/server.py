@@ -148,7 +148,9 @@ def create_app(client: ChatClient, asr=None, tts=None) -> web.Application:
             raise web.HTTPInternalServerError(text=str(exc)) from exc
         finally:
             shutil.rmtree(tmp_dir, ignore_errors=True)
-        obs_metrics.REGISTRY.counter("frontend_uploads_total").inc()
+        obs_metrics.REGISTRY.counter(
+            "frontend_uploads_total",
+            "documents uploaded through the frontend").inc()
         return web.json_response(entry)
 
     async def api_kb(request: web.Request) -> web.Response:

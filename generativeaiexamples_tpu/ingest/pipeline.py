@@ -91,7 +91,8 @@ class IngestPipeline:
                 chunks = self.splitter.split_text(text or "")
                 self.stats.documents_extracted += 1
                 obs_metrics.REGISTRY.counter(
-                    "ingest_documents_total").inc()
+                    "ingest_documents_total",
+                    "documents extracted by the ingest pipeline").inc()
                 for i, chunk in enumerate(chunks):
                     self.stats.chunks += 1
                     await out_q.put((chunk, {**item.metadata,
@@ -99,7 +100,9 @@ class IngestPipeline:
                                              "source_id": item.source_id}))
             except Exception as exc:  # noqa: BLE001 — skip bad documents
                 self.stats.errors += 1
-                obs_metrics.REGISTRY.counter("ingest_errors_total").inc()
+                obs_metrics.REGISTRY.counter(
+                    "ingest_errors_total",
+                    "documents the ingest pipeline failed on").inc()
                 logger.warning("extract failed for %s: %s",
                                item.source_id or item.path, exc)
 
@@ -116,8 +119,9 @@ class IngestPipeline:
                 None, lambda: self.index.add_texts(texts, metas))
             self.stats.chunks_stored += len(batch)
             self.stats.batches += 1
-            obs_metrics.REGISTRY.counter("ingest_chunks_total"
-                                         ).inc(len(batch))
+            obs_metrics.REGISTRY.counter(
+                "ingest_chunks_total",
+                "chunks stored by the ingest pipeline").inc(len(batch))
             batch.clear()
 
         while True:
@@ -145,7 +149,9 @@ class IngestPipeline:
             async for item in self.source:
                 await raw_q.put(item)
                 self.stats.items_in += 1
-                obs_metrics.REGISTRY.counter("ingest_items_total").inc()
+                obs_metrics.REGISTRY.counter(
+                    "ingest_items_total",
+                    "source items entering the ingest pipeline").inc()
                 n += 1
                 if self.max_items is not None and n >= self.max_items:
                     break

@@ -6,6 +6,6 @@ opentelemetry_callback.py) plus the metrics registry the reference lacks
 (SURVEY.md §5: "No first-party metrics registry — a gap to fix").
 """
 
-from . import flight, metrics, tracing
+from . import flight, metrics, rounds, tracing
 
-__all__ = ["flight", "metrics", "tracing"]
+__all__ = ["flight", "metrics", "rounds", "tracing"]

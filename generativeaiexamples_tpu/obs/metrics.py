@@ -47,6 +47,12 @@ def _escape(value) -> str:
             .replace("\n", "\\n"))
 
 
+def _escape_help(text: str) -> str:
+    """HELP-line escaping per the exposition format: backslash and
+    newline only (quotes are legal in help text)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _fmt_labels(pairs: Sequence[tuple[str, object]]) -> str:
     if not pairs:
         return ""
@@ -244,11 +250,16 @@ class Registry:
     def render_prometheus(self) -> str:
         """Prometheus text exposition format. Histogram state is copied
         under each histogram's lock (snapshot_state), so the rendered
-        cumulative buckets always agree with _count."""
+        cumulative buckets always agree with _count. Metrics registered
+        with a help string emit a ``# HELP`` line before their
+        ``# TYPE`` — the one-line description dashboards and operators
+        see on the raw scrape."""
         lines: list[str] = []
         with self._lock:
             metrics = list(self._metrics.values())
         for m in metrics:
+            if m.help:
+                lines.append(f"# HELP {m.name} {_escape_help(m.help)}")
             lines.append(f"# TYPE {m.name} {m._kind}")
             if isinstance(m, Histogram):
                 for pairs, leaf in m._samples():
@@ -291,6 +302,86 @@ class Registry:
 
 
 REGISTRY = Registry()
+
+
+#: Counters allowed to violate the ``_total`` naming convention, with
+#: the reason each is grandfathered. Everything else that renders as a
+#: counter must end in ``_total`` — enforced by ``lint_prometheus``
+#: (tier-1: tests/test_metrics_lint.py). Add here ONLY with a
+#: justification;
+#: renaming a published metric breaks every dashboard pinned to it.
+COUNTER_NAME_EXCEPTIONS: dict[str, str] = {
+    "router_affinity_hits": (
+        "published since PR 7 and documented in the fenced router "
+        "table; renaming would orphan fleet dashboards"),
+}
+
+_SAMPLE_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def lint_prometheus(text: str,
+                    counter_exceptions: Optional[dict] = None
+                    ) -> list[str]:
+    """Lint a Prometheus text-format exposition; returns every problem
+    found (empty list = clean). Checks:
+
+    - every sample line belongs to the family most recently declared by
+      ``# TYPE`` (histograms may suffix ``_bucket``/``_sum``/``_count``);
+    - no family is declared twice;
+    - every family carries a ``# HELP`` line;
+    - counters end in ``_total`` unless listed in
+      ``COUNTER_NAME_EXCEPTIONS`` (documented grandfathered names).
+    """
+    if counter_exceptions is None:
+        counter_exceptions = COUNTER_NAME_EXCEPTIONS
+    errors: list[str] = []
+    seen_families: set[str] = set()
+    helped: set[str] = set()
+    family = ""
+    kind = ""
+    for ln, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            parts = line.split(" ", 3)
+            if len(parts) < 4 or not parts[3].strip():
+                errors.append(f"line {ln}: HELP line has no text: {line!r}")
+            if len(parts) >= 3:
+                helped.add(parts[2])
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(" ")
+            if len(parts) != 4:
+                errors.append(f"line {ln}: malformed TYPE line: {line!r}")
+                continue
+            family, kind = parts[2], parts[3]
+            if family in seen_families:
+                errors.append(
+                    f"line {ln}: duplicate family {family!r} — a second "
+                    f"TYPE declaration shadows the first")
+            seen_families.add(family)
+            if kind == "counter" and not family.endswith("_total") \
+                    and family not in counter_exceptions:
+                errors.append(
+                    f"line {ln}: counter {family!r} does not end in "
+                    f"_total (add to COUNTER_NAME_EXCEPTIONS with a "
+                    f"reason, or rename)")
+            continue
+        if line.startswith("#"):
+            continue
+        name = line.split("{", 1)[0].split(" ", 1)[0]
+        ok = name == family
+        if not ok and kind == "histogram":
+            ok = any(name == family + s for s in _SAMPLE_SUFFIXES)
+        if not ok:
+            errors.append(
+                f"line {ln}: sample {name!r} does not match the "
+                f"declared family {family!r}")
+    for fam in sorted(seen_families - helped):
+        errors.append(
+            f"family {fam!r} has no # HELP line — pass help_txt where "
+            f"the metric is registered")
+    return errors
 
 
 # Per-stage children of the default registry's engine_stage_seconds,
@@ -355,10 +446,16 @@ def record_engine_stats(stats: dict, registry: Registry = REGISTRY,
     for key, value in stats.items():
         if isinstance(value, bool) or not isinstance(value, (int, float)):
             continue
-        registry.gauge(prefix + key).set(float(value))
+        registry.gauge(
+            prefix + key,
+            f"Engine.stats() mirror of {key} (see the fenced gauge "
+            f"table in docs/observability.md)").set(float(value))
     for total_key, count_key in ENGINE_STAGE_AVGS:
         if stats.get(count_key):
-            registry.gauge(prefix + total_key + "_avg").set(
+            registry.gauge(
+                prefix + total_key + "_avg",
+                f"derived per-event average of engine_{total_key} over "
+                f"engine_{count_key}").set(
                 float(stats[total_key]) / float(stats[count_key]))
 
 
@@ -375,25 +472,38 @@ class RequestTimer:
         self._start = time.monotonic()
         self._first: Optional[float] = None
         self._tokens = 0
-        registry.counter(f"{name}_requests_total").inc()
+        registry.counter(f"{name}_requests_total",
+                         f"{name} requests started").inc()
 
     def token(self, n: int = 1) -> None:
         if self._first is None:
             self._first = time.monotonic()
-            self.registry.histogram(f"{self.name}_ttft_seconds").observe(
+            self.registry.histogram(
+                f"{self.name}_ttft_seconds",
+                f"{self.name} time to first token, seconds").observe(
                 self._first - self._start)
         self._tokens += n
 
     def finish(self) -> None:
         dur = time.monotonic() - self._start
-        self.registry.histogram(f"{self.name}_duration_seconds").observe(dur)
+        self.registry.histogram(
+            f"{self.name}_duration_seconds",
+            f"{self.name} request duration, seconds").observe(dur)
         if self._tokens and dur > 0:
             tps = self._tokens / dur
-            self.registry.counter(f"{self.name}_tokens_total").inc(self._tokens)
+            self.registry.counter(
+                f"{self.name}_tokens_total",
+                f"tokens generated by {self.name} requests").inc(
+                self._tokens)
             # The histogram is the real distribution under concurrency;
             # the last-write-wins gauge stays published for dashboards
             # pinned to the old name.
-            self.registry.histogram(f"{self.name}_tokens_per_second",
-                                    buckets=TPS_BUCKETS).observe(tps)
-            self.registry.gauge(f"{self.name}_last_tokens_per_second").set(
-                tps)
+            self.registry.histogram(
+                f"{self.name}_tokens_per_second",
+                f"per-request {self.name} token throughput distribution",
+                buckets=TPS_BUCKETS).observe(tps)
+            self.registry.gauge(
+                f"{self.name}_last_tokens_per_second",
+                f"last completed {self.name} request's tokens/sec "
+                f"(legacy last-write-wins gauge; prefer the histogram)"
+            ).set(tps)

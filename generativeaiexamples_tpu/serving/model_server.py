@@ -298,6 +298,15 @@ def create_server_app(engine, embed_service=None,
              "engine": dict(engine.stats)})
 
     async def metrics_endpoint(request: web.Request) -> web.Response:
+        # Scrape-time engine snapshot (same contract as the chain
+        # server's /metrics): every numeric Engine.stats() key mirrors
+        # as an engine_* gauge, so both server surfaces expose the
+        # doc-checked gauge table — including the round-telemetry and
+        # cost-drift counters.
+        try:
+            obs_metrics.record_engine_stats(engine.stats)
+        except Exception:  # noqa: BLE001 — metrics must never 500
+            logger.debug("engine stats unavailable", exc_info=True)
         return web.Response(text=obs_metrics.REGISTRY.render_prometheus(),
                             content_type="text/plain")
 
@@ -308,6 +317,14 @@ def create_server_app(engine, embed_service=None,
         # minted cmpl- id) onto their engine submissions.
         from ..obs import flight as obs_flight
         return obs_flight.debug_requests_response(request)
+
+    async def debug_rounds(request: web.Request) -> web.Response:
+        # Engine-level round telemetry (obs/rounds.py): per-round
+        # plan + execution records and rolling aggregates — the
+        # engine's side of the story /debug/requests tells per request.
+        from ..obs import rounds as obs_rounds
+        return obs_rounds.debug_rounds_response(
+            request, getattr(engine, "rounds", None))
 
     # On-demand device profiling (SURVEY §5: the jax.profiler endpoint on
     # the serving engine — the role nsys would play on the reference's
@@ -473,6 +490,7 @@ def create_server_app(engine, embed_service=None,
     app.router.add_get("/health", health)
     app.router.add_get("/metrics", metrics_endpoint)
     app.router.add_get("/debug/requests", debug_requests)
+    app.router.add_get("/debug/rounds", debug_rounds)
     app.router.add_post("/v1/score", score)
     app.router.add_post("/profiler/start", profiler_start)
     app.router.add_post("/profiler/stop", profiler_stop)
@@ -540,6 +558,14 @@ def main(argv: Optional[list[str]] = None) -> None:
     if maybe_init_distributed(args.coordinator, args.num_processes,
                               args.process_id):
         logger.info("jax.distributed initialized (multi-host DCN)")
+
+    # Pid file under the run dir (GAIE_RUN_DIR, default under /tmp) —
+    # launcher scripts should read this instead of `echo $! > server.pid`
+    # littering whatever directory they were started from.
+    from ..utils.logging import write_pid_file
+    pid_path = write_pid_file(f"model-server-{args.port}")
+    if pid_path:
+        logger.info("pid file: %s", pid_path)
 
     engine, embed_service, model_name = build_services(
         model_type=args.model_type, model_name=args.model_name,

@@ -44,6 +44,43 @@ def log_event(logger: logging.Logger, event: str, *,
     logger.log(level, "%s %s", event, payload)
 
 
+def write_pid_file(name: str) -> str | None:
+    """Record this process's pid under the run directory
+    (``GAIE_RUN_DIR``, default ``/tmp/generativeaiexamples_tpu/run``) as
+    ``<name>.pid``, removed at clean exit. Returns the path, or None on
+    failure (a pid file is a convenience, never a boot blocker).
+
+    This is the sanctioned place for server pids — ad-hoc ``echo $! >
+    server.pid`` launcher lines used to litter the repo root; point
+    them here (or just use the file this writes)."""
+    run_dir = os.environ.get("GAIE_RUN_DIR",
+                             "/tmp/generativeaiexamples_tpu/run")
+    path = os.path.join(run_dir, f"{name}.pid")
+    try:
+        os.makedirs(run_dir, exist_ok=True)
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(str(os.getpid()))
+    except OSError:
+        logging.getLogger(__name__).debug("cannot write pid file %s", path)
+        return None
+    import atexit
+    pid = os.getpid()
+
+    def _cleanup() -> None:
+        # Remove only OUR pid file: during a restart overlap the new
+        # process has already overwritten it, and the old process's
+        # exit must not delete the live server's record.
+        try:
+            with open(path, encoding="utf-8") as fh:
+                if fh.read().strip() != str(pid):
+                    return
+            os.remove(path)
+        except OSError:
+            pass
+    atexit.register(_cleanup)
+    return path
+
+
 def write_termination_log(message: str, path: str | None = None) -> None:
     """Write a k8s termination log if the path is writable.
 

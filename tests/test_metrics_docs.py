@@ -6,8 +6,10 @@ ship undocumented."""
 
 import pytest
 
-from tools.check_metrics_docs import (BEGIN, END, ROUTER_BEGIN, ROUTER_END,
-                                      check, documented_gauges,
+from tools.check_metrics_docs import (BEGIN, END, ROUNDS_BEGIN, ROUNDS_END,
+                                      ROUTER_BEGIN, ROUTER_END, check,
+                                      documented_gauges,
+                                      documented_round_metrics,
                                       documented_router_metrics)
 
 
@@ -20,7 +22,8 @@ def test_checker_flags_ghost_and_missing_gauges():
     is a ghost; dropping a documented row leaves a stats key missing."""
     ghost = (f"{BEGIN}\n| `engine_requests` | x |\n"
              f"| `engine_not_a_real_stat` | x |\n{END}\n"
-             f"{ROUTER_BEGIN}{ROUTER_END}")  # router fence: separate tests
+             f"{ROUTER_BEGIN}{ROUTER_END}"   # other fences: own tests
+             f"{ROUNDS_BEGIN}{ROUNDS_END}")
     errors = check(ghost)
     assert any("engine_not_a_real_stat" in e for e in errors)
     assert any("engine_tokens_generated" in e for e in errors)  # missing
@@ -64,3 +67,28 @@ def test_router_docs_names_ignore_label_suffixes():
 def test_checker_requires_router_markers():
     with pytest.raises(SystemExit):
         documented_router_metrics(f"{BEGIN} {END} no router fence")
+
+
+def _with_rounds_fence(rows: str) -> str:
+    """The real doc with only the ROUND fence replaced — isolates the
+    round-telemetry direction of the check."""
+    import tools.check_metrics_docs as mod
+    with open(mod.DOC_PATH) as f:
+        text = f.read()
+    start = text.index(ROUNDS_BEGIN)
+    end = text.index(ROUNDS_END) + len(ROUNDS_END)
+    return text[:start] + f"{ROUNDS_BEGIN}\n{rows}\n{ROUNDS_END}" \
+        + text[end:]
+
+
+def test_checker_flags_ghost_and_missing_round_metrics():
+    errors = check(_with_rounds_fence(
+        "| `engine_rounds_total` | x |\n"
+        "| `engine_round_not_real` | x |"))
+    assert any("engine_round_not_real" in e for e in errors)
+    assert any("sched_cost_drift_ratio" in e for e in errors)  # missing
+
+
+def test_checker_requires_round_markers():
+    with pytest.raises(SystemExit):
+        documented_round_metrics(f"{BEGIN} {END} no round fence")

@@ -1,0 +1,108 @@
+"""Prometheus text-format lint (obs/metrics.py lint_prometheus): HELP
+lines present, sample lines match their declared family, no duplicate
+families, counters end in _total (or are documented exceptions) — run
+over the repo's real metric surfaces rendered into a fresh registry."""
+
+from generativeaiexamples_tpu.engine.engine import _STATS_TEMPLATE
+from generativeaiexamples_tpu.obs import metrics as obs_metrics
+from generativeaiexamples_tpu.obs.metrics import (
+    COUNTER_NAME_EXCEPTIONS, Registry, RequestTimer, lint_prometheus)
+
+
+def _populated_registry() -> Registry:
+    """A fresh registry carrying every declared metric surface, built
+    through the same helpers production uses."""
+    reg = Registry()
+    # Engine gauge mirror (chain + model servers' /metrics).
+    stats = dict(_STATS_TEMPLATE)
+    stats["harvest_rounds"] = 2
+    stats["harvest_wait_ms"] = 10.0
+    obs_metrics.record_engine_stats(stats, registry=reg)
+    # Stage histogram + request-class timers.
+    obs_metrics.observe_stage("engine_ttft", 0.1, registry=reg)
+    timer = RequestTimer("chain_generate", registry=reg)
+    timer.token(4)
+    timer.finish()
+    # Round telemetry surface (obs/rounds.py declarations).
+    from generativeaiexamples_tpu.obs.rounds import (ROUND_METRICS,
+                                                     ROUND_TOKEN_BUCKETS)
+    for name, (kind, help_txt) in ROUND_METRICS.items():
+        if kind == "counter":
+            reg.counter(name, help_txt).inc()
+        elif kind == "gauge":
+            reg.gauge(name, help_txt).set(1.0)
+        else:
+            buckets = (ROUND_TOKEN_BUCKETS
+                       if name == "engine_round_tokens"
+                       else obs_metrics.STAGE_BUCKETS)
+            reg.histogram(name, help_txt, buckets=buckets).observe(1.0)
+    # Router surface (its declared rows carry kind/labels/help).
+    from generativeaiexamples_tpu.router.metrics import ROUTER_METRICS
+    for name, (kind, labels, help_txt) in ROUTER_METRICS.items():
+        m = (reg.counter if kind == "counter" else reg.gauge)(
+            name, help_txt, labelnames=labels)
+        leaf = m.labels(*(["r0"] * len(labels))) if labels else m
+        leaf.inc() if kind == "counter" else leaf.set(1.0)
+    # Robustness surface.
+    reg.counter("shed_total", "requests rejected at admission, by reason",
+                labelnames=("reason",)).labels("queue_full").inc()
+    reg.gauge("breaker_state",
+              "circuit breaker state (0 closed, 1 half-open, 2 open)",
+              labelnames=("name",)).labels("retrieval").set(0)
+    reg.counter("breaker_trips_total",
+                "breaker closed/half-open -> open transitions",
+                labelnames=("name",)).labels("retrieval").inc()
+    return reg
+
+
+def test_real_surfaces_render_clean():
+    text = _populated_registry().render_prometheus()
+    assert lint_prometheus(text) == []
+    # HELP lines actually present, before their TYPE line
+    lines = text.splitlines()
+    idx_help = lines.index(
+        "# HELP engine_rounds_total engine rounds completed: plan "
+        "sealed AND every device output of the round harvested")
+    assert lines[idx_help + 1].startswith("# TYPE engine_rounds_total ")
+
+
+def test_lint_flags_counter_without_total_suffix():
+    reg = Registry()
+    reg.counter("oops_count", "a misnamed counter").inc()
+    errors = lint_prometheus(reg.render_prometheus())
+    assert any("oops_count" in e and "_total" in e for e in errors)
+    # a documented exception passes
+    errors = lint_prometheus(reg.render_prometheus(),
+                             counter_exceptions={"oops_count": "legacy"})
+    assert errors == []
+
+
+def test_lint_flags_missing_help():
+    reg = Registry()
+    reg.counter("things_total").inc()
+    errors = lint_prometheus(reg.render_prometheus())
+    assert any("no # HELP" in e for e in errors)
+
+
+def test_lint_flags_duplicate_family_and_family_mismatch():
+    text = ("# HELP a_total x\n# TYPE a_total counter\n"
+            "a_total 1\n"
+            "# HELP b_total x\n# TYPE b_total counter\n"
+            "rogue_sample 2\n"
+            "# HELP a_total x\n# TYPE a_total counter\n"
+            "a_total 3\n")
+    errors = lint_prometheus(text)
+    assert any("duplicate family 'a_total'" in e for e in errors)
+    assert any("rogue_sample" in e for e in errors)
+
+
+def test_lint_accepts_histogram_suffixes_and_labels():
+    reg = Registry()
+    h = reg.histogram("lat_seconds", "latency", labelnames=("stage",))
+    h.labels("prefill").observe(0.2)
+    assert lint_prometheus(reg.render_prometheus()) == []
+
+
+def test_exception_table_documents_reasons():
+    for name, reason in COUNTER_NAME_EXCEPTIONS.items():
+        assert isinstance(reason, str) and len(reason) > 10, name

@@ -1,0 +1,112 @@
+"""tools/perf_diff.py: the perf regression gate — headline-metric
+extraction, threshold semantics, CLI exit codes, and a tier-1 run over
+the committed BENCH_rNN artifacts."""
+
+import json
+import os
+
+import pytest
+
+from tools.perf_diff import (DEFAULT_THRESHOLD_PCT, compare,
+                             extract_metrics, main)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _result(**over):
+    base = {
+        "decode_tokens_per_sec": 500.0,
+        "engine_p50_ttft_ms": 150.0,
+        "engine_p99_ttft_ms": 180.0,
+        "hbm_bw_util": 0.72,
+        "chat": {"warm_p50_ttft_ms": 40.0,
+                 "spec": {"tokens_per_step": 1.8}},
+        "openloop": {"rates": [
+            {"arrival_rps": 2.0, "slo_attainment": 0.95,
+             "goodput_tokens_per_sec": 900.0},
+            {"arrival_rps": 4.0, "slo_attainment": 0.80,
+             "goodput_tokens_per_sec": 1500.0},
+        ]},
+    }
+    base.update(over)
+    return base
+
+
+def test_extract_flattens_headline_metrics():
+    m = extract_metrics(_result())
+    assert m["decode_tokens_per_sec"] == (500.0, "higher")
+    assert m["engine_p50_ttft_ms"] == (150.0, "lower")
+    assert m["chat.warm_p50_ttft_ms"] == (40.0, "lower")
+    assert m["slo_attainment@2"] == (0.95, "higher")
+    assert m["goodput_tokens_per_sec@4"] == (1500.0, "higher")
+    assert m["spec.tokens_per_step"] == (1.8, "higher")
+    # driver artifact wrapper unwraps
+    assert extract_metrics({"parsed": _result()})["hbm_bw_util"][0] == 0.72
+
+
+def test_extract_tolerates_missing_sections():
+    m = extract_metrics({"decode_tokens_per_sec": 100.0, "chat": {}})
+    assert set(m) == {"decode_tokens_per_sec"}
+
+
+def test_compare_direction_aware():
+    base = extract_metrics(_result())
+    # throughput DOWN 20% -> regression; TTFT DOWN 20% -> improvement
+    new = extract_metrics(_result(decode_tokens_per_sec=400.0,
+                                  engine_p50_ttft_ms=120.0))
+    regressions, notes = compare(base, new)
+    assert any("decode_tokens_per_sec" in r for r in regressions)
+    assert not any("engine_p50_ttft_ms" in r for r in regressions)
+    assert any(n.startswith("improved engine_p50_ttft_ms")
+               for n in notes)
+    # inside the default threshold: no regression
+    small = extract_metrics(_result(
+        decode_tokens_per_sec=500.0 * (1 - DEFAULT_THRESHOLD_PCT / 200)))
+    assert compare(base, small)[0] == []
+
+
+def test_compare_per_metric_threshold_and_skips():
+    base = extract_metrics(_result())
+    new = extract_metrics(_result(decode_tokens_per_sec=460.0))  # -8%
+    assert compare(base, new)[0]                       # default 5% trips
+    regs, _ = compare(base, new,
+                      per_metric_pct={"decode_tokens_per_sec": 10.0})
+    assert regs == []                                  # widened: passes
+    # a metric absent from one side is skipped with a note, not a fail
+    lean = extract_metrics({"decode_tokens_per_sec": 500.0})
+    regs, notes = compare(base, lean)
+    assert regs == []
+    assert any(n.startswith("skip engine_p50_ttft_ms") for n in notes)
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    base_p = tmp_path / "base.json"
+    new_p = tmp_path / "new.json"
+    base_p.write_text(json.dumps(_result()))
+    new_p.write_text(json.dumps(_result(decode_tokens_per_sec=300.0)))
+    assert main([str(base_p), str(base_p)]) == 0
+    assert main([str(base_p), str(new_p)]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSION" in out and "decode_tokens_per_sec" in out
+    # per-metric override rescues it
+    assert main([str(base_p), str(new_p),
+                 "--threshold", "decode_tokens_per_sec=50"]) == 0
+    # unusable artifacts are a usage error, not a crash
+    empty = tmp_path / "empty.json"
+    empty.write_text("{}")
+    assert main([str(base_p), str(empty)]) == 2
+    assert main([str(base_p), str(tmp_path / "missing.json")]) == 2
+
+
+@pytest.mark.parametrize("pair,expect", [
+    (("BENCH_r04.json", "BENCH_r05.json"), 0),   # r05 did not regress r04
+    (("BENCH_r01.json", "BENCH_r05.json"), 0),   # the whole trajectory
+])
+def test_committed_artifacts_gate(pair, expect):
+    """Tier-1 over the committed round artifacts: the recorded perf
+    trajectory is monotone enough that each later round passes the gate
+    against the earlier one (p99 wobble gets a wider threshold — single
+    -digit-sample tail percentiles jitter between runs)."""
+    base, new = (os.path.join(REPO, p) for p in pair)
+    rc = main([base, new, "--threshold", "engine_p99_ttft_ms=20"])
+    assert rc == expect

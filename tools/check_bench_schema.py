@@ -75,8 +75,8 @@ def validate_result(result: dict, schema: dict | None = None) -> None:
     schema = schema or load_schema()
     errors: list[str] = []
     _check_types("result", result, schema["top_level"], errors)
-    for section in ("engine_pipeline", "e2e_ttft_dist_ms", "chat",
-                    "openloop", "fleet", "capacity"):
+    for section in ("engine_pipeline", "engine_rounds", "e2e_ttft_dist_ms",
+                    "chat", "openloop", "fleet", "capacity"):
         sub = result.get(section)
         if isinstance(sub, dict):
             _check_types(section, sub, schema[section], errors)

@@ -22,6 +22,14 @@ Two fenced tables, each enforced BOTH ways:
   must document exactly those names — same contract, same failure
   modes.
 
+- **Round telemetry metrics.** The engine's round recorder declares its
+  surface in ``obs.rounds.ROUND_METRICS``; the table between
+
+      <!-- round-metrics:begin --> ... <!-- round-metrics:end -->
+
+  must document exactly those names (``engine_round_*`` plus
+  ``sched_cost_drift_ratio``).
+
 Registry-level metrics that are NOT part of either surface (the labeled
 ``engine_stage_seconds`` histogram, ``shed_total``...) live OUTSIDE the
 fences and are not checked here.
@@ -43,9 +51,12 @@ BEGIN = "<!-- engine-stats:begin -->"
 END = "<!-- engine-stats:end -->"
 ROUTER_BEGIN = "<!-- router-metrics:begin -->"
 ROUTER_END = "<!-- router-metrics:end -->"
+ROUNDS_BEGIN = "<!-- round-metrics:begin -->"
+ROUNDS_END = "<!-- round-metrics:end -->"
 
 _GAUGE_RE = re.compile(r"`engine_([a-z0-9_]+)`")
 _ROUTER_RE = re.compile(r"`router_([a-z0-9_]+)")  # name may carry {label=}
+_ROUNDS_RE = re.compile(r"`([a-z0-9_]+)")         # engine_round_* + sched_*
 
 
 def _fenced(doc_text: str, begin: str, end: str) -> str:
@@ -87,6 +98,18 @@ def expected_router_metrics() -> set[str]:
     return set(ROUTER_METRICS)
 
 
+def documented_round_metrics(doc_text: str) -> set[str]:
+    """Metric names inside the round-telemetry fence (backtick-quoted;
+    histogram ``_bucket``-style suffixes are prose, not names)."""
+    return set(_ROUNDS_RE.findall(
+        _fenced(doc_text, ROUNDS_BEGIN, ROUNDS_END)))
+
+
+def expected_round_metrics() -> set[str]:
+    from generativeaiexamples_tpu.obs.rounds import ROUND_METRICS
+    return set(ROUND_METRICS)
+
+
 def check(doc_text: str | None = None) -> list[str]:
     """Every mismatch between the docs tables and the code surfaces;
     empty on a clean tree."""
@@ -115,6 +138,18 @@ def check(doc_text: str | None = None) -> list[str]:
         errors.append(
             f"router.metrics.ROUTER_METRICS declares {name} but "
             f"docs/observability.md's router table does not document it")
+    doc_rounds = documented_round_metrics(doc_text)
+    rounds = expected_round_metrics()
+    for name in sorted(doc_rounds - rounds):
+        errors.append(
+            f"docs/observability.md documents {name} but "
+            f"obs.rounds.ROUND_METRICS has no such metric (stale doc "
+            f"after a round-telemetry rename?)")
+    for name in sorted(rounds - doc_rounds):
+        errors.append(
+            f"obs.rounds.ROUND_METRICS declares {name} but "
+            f"docs/observability.md's round-telemetry table does not "
+            f"document it")
     return errors
 
 
@@ -125,7 +160,8 @@ def main() -> int:
             print(f"FAIL — {e}")
         return 1
     print(f"{DOC_PATH}: engine gauge table in sync with Engine.stats(); "
-          f"router table in sync with ROUTER_METRICS")
+          f"router table in sync with ROUTER_METRICS; round table in "
+          f"sync with ROUND_METRICS")
     return 0
 
 

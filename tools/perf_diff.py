@@ -1,0 +1,208 @@
+"""Perf regression gate: diff two bench JSON artifacts on headline
+metrics.
+
+The repo's perf trajectory is a series of committed ``BENCH_rNN.json``
+artifacts, but until now nothing TOOLED the comparison — a regression
+between rounds was only visible to a human reading two JSON blobs. This
+tool compares a candidate run against a baseline on the headline
+metrics and exits non-zero when any regresses past its threshold, so it
+can gate CI (or the driver's round loop):
+
+    python tools/perf_diff.py BENCH_r05.json fresh_run.json
+    python tools/perf_diff.py base.json new.json --threshold-pct 3 \
+        --threshold decode_tokens_per_sec=10
+
+Metrics compared (each skipped with a note when absent from either
+artifact — older rounds predate some sections):
+
+======================================  =========  =====================
+metric                                  direction  source
+======================================  =========  =====================
+``decode_tokens_per_sec``               higher     top level
+``engine_p50_ttft_ms``                  lower      top level
+``engine_p99_ttft_ms``                  lower      top level
+``e2e_chat_ttft_ms``                    lower      top level
+``chat.warm_p50_ttft_ms``               lower      chat scenario
+``hbm_bw_util``                         higher     top level
+``slo_attainment@<rps>``                higher     openloop, per common
+                                                   swept rate
+``goodput_tokens_per_sec@<rps>``        higher     openloop, per rate
+``spec.tokens_per_step``                higher     chat/openloop spec
+                                                   block (first present)
+======================================  =========  =====================
+
+Accepts raw bench results or the driver's artifact wrapper (an object
+with a ``parsed`` sub-object). Exit codes: 0 = no regression, 1 =
+regression(s), 2 = usage/IO error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional
+
+#: metric -> direction ("higher" = bigger is better).
+HEADLINE_METRICS: dict[str, str] = {
+    "decode_tokens_per_sec": "higher",
+    "engine_p50_ttft_ms": "lower",
+    "engine_p99_ttft_ms": "lower",
+    "e2e_chat_ttft_ms": "lower",
+    "chat.warm_p50_ttft_ms": "lower",
+    "hbm_bw_util": "higher",
+    # openloop per-rate and spec metrics are added dynamically by
+    # extract_metrics with the directions below
+}
+_OPENLOOP_DIRECTIONS = {"slo_attainment": "higher",
+                        "goodput_tokens_per_sec": "higher"}
+_SPEC_DIRECTION = ("spec.tokens_per_step", "higher")
+
+DEFAULT_THRESHOLD_PCT = 5.0
+
+
+def _num(value) -> Optional[float]:
+    if isinstance(value, bool) or not isinstance(value, (int, float)):
+        return None
+    return float(value)
+
+
+def extract_metrics(result: dict) -> dict[str, tuple[float, str]]:
+    """Flatten one bench result into ``{metric: (value, direction)}``.
+    Missing sections simply contribute nothing — the comparison later
+    skips metrics absent on either side."""
+    result = result.get("parsed", result)   # driver artifact wrapper
+    out: dict[str, tuple[float, str]] = {}
+    for name, direction in HEADLINE_METRICS.items():
+        obj = result
+        ok = True
+        for part in name.split("."):
+            if not isinstance(obj, dict) or part not in obj:
+                ok = False
+                break
+            obj = obj[part]
+        v = _num(obj) if ok else None
+        if v is not None:
+            out[name] = (v, direction)
+    openloop = result.get("openloop")
+    if isinstance(openloop, dict):
+        for entry in openloop.get("rates") or []:
+            if not isinstance(entry, dict):
+                continue
+            rps = entry.get("arrival_rps")
+            if rps is None:
+                continue
+            for key, direction in _OPENLOOP_DIRECTIONS.items():
+                v = _num(entry.get(key))
+                if v is not None:
+                    out[f"{key}@{rps:g}"] = (v, direction)
+    for section in ("chat", "openloop"):
+        spec = (result.get(section) or {}) if \
+            isinstance(result.get(section), dict) else {}
+        block = spec.get("spec")
+        if isinstance(block, dict):
+            v = _num(block.get("tokens_per_step"))
+            if v is not None and _SPEC_DIRECTION[0] not in out:
+                out[_SPEC_DIRECTION[0]] = (v, _SPEC_DIRECTION[1])
+    return out
+
+
+def compare(base: dict, new: dict,
+            threshold_pct: float = DEFAULT_THRESHOLD_PCT,
+            per_metric_pct: Optional[dict[str, float]] = None
+            ) -> tuple[list[str], list[str]]:
+    """Compare two extracted metric maps. Returns ``(regressions,
+    notes)`` — regressions are metrics that moved in the WRONG direction
+    by more than their threshold percent; notes cover skips and
+    improvements."""
+    per_metric_pct = per_metric_pct or {}
+    regressions: list[str] = []
+    notes: list[str] = []
+    for name in sorted(set(base) | set(new)):
+        if name not in base or name not in new:
+            side = "baseline" if name not in base else "candidate"
+            notes.append(f"skip {name}: absent from {side}")
+            continue
+        b, direction = base[name]
+        n, _ = new[name]
+        if b == 0:
+            notes.append(f"skip {name}: baseline is 0")
+            continue
+        # Signed change in the GOOD direction, percent of baseline.
+        delta_pct = (n - b) / abs(b) * 100.0
+        if direction == "lower":
+            delta_pct = -delta_pct
+        limit = per_metric_pct.get(name, threshold_pct)
+        arrow = f"{b:g} -> {n:g}"
+        if delta_pct < -limit:
+            regressions.append(
+                f"{name}: {arrow} ({-delta_pct:.1f}% worse, "
+                f"threshold {limit:g}%)")
+        elif delta_pct > limit:
+            notes.append(f"improved {name}: {arrow} "
+                         f"(+{delta_pct:.1f}%)")
+        else:
+            notes.append(f"ok {name}: {arrow} ({delta_pct:+.1f}%)")
+    return regressions, notes
+
+
+def diff_files(base_path: str, new_path: str,
+               threshold_pct: float = DEFAULT_THRESHOLD_PCT,
+               per_metric_pct: Optional[dict[str, float]] = None
+               ) -> tuple[list[str], list[str]]:
+    with open(base_path) as f:
+        base = extract_metrics(json.load(f))
+    with open(new_path) as f:
+        new = extract_metrics(json.load(f))
+    if not base:
+        raise ValueError(f"{base_path}: no headline metrics found")
+    if not new:
+        raise ValueError(f"{new_path}: no headline metrics found")
+    return compare(base, new, threshold_pct, per_metric_pct)
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Compare two bench JSON artifacts; non-zero exit on "
+                    "a headline-metric regression (CI gate).")
+    parser.add_argument("baseline", help="baseline bench JSON "
+                                         "(e.g. BENCH_r05.json)")
+    parser.add_argument("candidate", help="candidate bench JSON")
+    parser.add_argument("--threshold-pct", type=float,
+                        default=DEFAULT_THRESHOLD_PCT,
+                        help="default allowed regression percent "
+                             "(default %(default)s)")
+    parser.add_argument("--threshold", action="append", default=[],
+                        metavar="METRIC=PCT",
+                        help="per-metric threshold override "
+                             "(repeatable), e.g. "
+                             "--threshold engine_p50_ttft_ms=10")
+    args = parser.parse_args(argv)
+    per_metric: dict[str, float] = {}
+    for spec in args.threshold:
+        name, sep, pct = spec.partition("=")
+        if not sep:
+            parser.error(f"--threshold needs METRIC=PCT, got {spec!r}")
+        try:
+            per_metric[name.strip()] = float(pct)
+        except ValueError:
+            parser.error(f"--threshold {spec!r}: PCT must be numeric")
+    try:
+        regressions, notes = diff_files(
+            args.baseline, args.candidate, args.threshold_pct, per_metric)
+    except (OSError, ValueError, json.JSONDecodeError) as exc:
+        print(f"perf_diff: {exc}", file=sys.stderr)
+        return 2
+    for note in notes:
+        print(note)
+    if regressions:
+        print(f"\n{len(regressions)} REGRESSION(S) vs {args.baseline}:")
+        for r in regressions:
+            print(f"  FAIL {r}")
+        return 1
+    print(f"\nno regressions vs {args.baseline}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
