@@ -47,6 +47,7 @@ import os
 import sys
 import threading
 import time
+from typing import Optional
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
@@ -608,12 +609,15 @@ def run_capacity_sweep(params, model_cfg, tokenizer, rungs, *,
     }
 
 
-def build_fleet_engines(params, model_cfg, tokenizer, n: int):
+def build_fleet_engines(params, model_cfg, tokenizer, n: int,
+                        host_pool_tokens: int = 0):
     """N small replica engines over SHARED params (read-only on device —
     weights are never duplicated) with explicit, modest KV pools
     (``BENCH_FLEET_KV_POOL_TOKENS``, default 4096 tokens each): the main
     bench engine's auto-sized pool still holds its HBM, so auto-sizing
-    here would starve; prewarm's shrink-on-OOM absorbs the rest."""
+    here would starve; prewarm's shrink-on-OOM absorbs the rest.
+    ``host_pool_tokens`` > 0 enables the host KV tier on every replica
+    (the cross-replica transfer arm needs it to land fetched pages)."""
     from generativeaiexamples_tpu.engine import Engine, EngineConfig
 
     pool = int(os.environ.get("BENCH_FLEET_KV_POOL_TOKENS", "4096"))
@@ -624,9 +628,19 @@ def build_fleet_engines(params, model_cfg, tokenizer, n: int):
         kv_pool_tokens=pool,
         kv_quant=os.environ.get("BENCH_KV_QUANT", ""),
         steps_per_round=int(os.environ.get("BENCH_STEPS_PER_ROUND", "16")),
-        dispatch_depth=int(os.environ.get("BENCH_DISPATCH_DEPTH", "2")))
-    engines = [Engine(params, model_cfg, tokenizer, ecfg)
-               for _ in range(n)]
+        dispatch_depth=int(os.environ.get("BENCH_DISPATCH_DEPTH", "2")),
+        kv_host_pool_tokens=max(0, int(host_pool_tokens)))
+    # Mask the env override for the build: KV_HOST_POOL_TOKENS beats the
+    # config field inside Engine, and the fleet arms' tier setting must
+    # come from `host_pool_tokens` (the arm matrix), not from whatever
+    # the operator pinned for the MAIN measured engine.
+    saved = os.environ.pop("KV_HOST_POOL_TOKENS", None)
+    try:
+        engines = [Engine(params, model_cfg, tokenizer, ecfg)
+                   for _ in range(n)]
+    finally:
+        if saved is not None:
+            os.environ["KV_HOST_POOL_TOKENS"] = saved
     for e in engines:
         e.prewarm()
     return engines
@@ -636,6 +650,7 @@ def run_fleet_bench(engines, *, sessions=6, turns=4, session_rps=2.0,
                     system_chars=1200, user_chars=120, num_tokens=16,
                     slo_ttft_ms=2000.0, seed=0,
                     policies=("round_robin", "affinity"),
+                    transfer_arm=False,
                     heartbeat_s=0.5):
     """Multi-replica scenario: open-loop Poisson session load through the
     FLEET ROUTER over N in-process chain-server replicas (docs/router.md).
@@ -657,6 +672,14 @@ def run_fleet_bench(engines, *, sessions=6, turns=4, session_rps=2.0,
     session's turns on the replica holding its prefix pages; round-robin
     re-prefills the whole history on a cold sibling every hop — that
     delta is the fleet-level warm-TTFT story.
+
+    ``transfer_arm`` grows a third arm (``affinity_transfer``): affinity
+    placement with the router's cross-replica KV-page transfer enabled
+    (``X-KV-Transfer-From`` donor hints; docs/kv-tiering.md) — a
+    placement miss then FETCHES the prefix pages from the sibling
+    instead of re-prefilling, so the arm's aggregate prefix-hit rate
+    should beat affinity-only. Requires the replicas built with the
+    host KV tier on (``build_fleet_engines(host_pool_tokens=...)``).
     """
     import statistics
 
@@ -701,10 +724,14 @@ def run_fleet_bench(engines, *, sessions=6, turns=4, session_rps=2.0,
             total += 6
         return " ".join(toks)[:n_chars]
 
-    def one_policy(policy: str, replica_urls: list[str]) -> dict:
+    def one_policy(policy: str, replica_urls: list[str],
+                   kv_transfer: bool = False,
+                   label: Optional[str] = None) -> dict:
+        label = label or policy
         router_app = create_router_app(
             [(f"r{i}", u) for i, u in enumerate(replica_urls)],
-            policy=policy, heartbeat_s=heartbeat_s, run_heartbeat=True)
+            policy=policy, heartbeat_s=heartbeat_s,
+            kv_transfer=kv_transfer, run_heartbeat=True)
         (router_url,), stop_router = serve_apps([router_app])
         snap0 = obs_metrics.REGISTRY.snapshot()
         before = [dict(e.stats) for e in engines]
@@ -713,7 +740,7 @@ def run_fleet_bench(engines, *, sessions=6, turns=4, session_rps=2.0,
 
         def run_session(i: int, start_delay: float):
             time.sleep(max(0.0, start_delay))
-            tag = f"{policy}-{seed}-{i}"
+            tag = f"{label}-{seed}-{i}"
             system = f"[session {tag}] " + words(tag, system_chars)
             history = ""
             for t in range(turns):
@@ -788,8 +815,12 @@ def run_fleet_bench(engines, *, sessions=6, turns=4, session_rps=2.0,
         placed = {f"r{i}": int(_delta(
             f'router_placed_total{{replica="r{i}"}}'))
             for i in range(len(replica_urls))}
+        transfer_pages = sum(
+            a.get("kv_tier_transfer_pages", 0)
+            - b.get("kv_tier_transfer_pages", 0)
+            for a, b in zip(after, before))
         return {
-            "policy": policy,
+            "policy": label,
             "offered_turns": sessions * turns,
             "completed": len(ok_rows),
             "errors": len(results) - len(ok_rows),
@@ -809,12 +840,17 @@ def run_fleet_bench(engines, *, sessions=6, turns=4, session_rps=2.0,
             "affinity_hit_placements": int(_delta("router_affinity_hits")),
             "retries_connect": int(_delta(
                 'router_retries_total{reason="connect"}')),
+            "kv_transfer": bool(kv_transfer),
+            "kv_transfer_pages": int(transfer_pages),
         }
 
+    arms = [(policy, False, policy) for policy in policies]
+    if transfer_arm:
+        arms.append(("affinity", True, "affinity_transfer"))
     replica_urls, stop_replicas = serve_apps(apps)
     try:
         policy_rows = []
-        for policy in policies:
+        for policy, kv_transfer, label in arms:
             for eng in engines:
                 try:
                     # Fresh caches per policy: a later policy must not
@@ -824,7 +860,9 @@ def run_fleet_bench(engines, *, sessions=6, turns=4, session_rps=2.0,
                     eng.reset()
                 except Exception:  # noqa: BLE001 — comparability only
                     pass
-            policy_rows.append(one_policy(policy, replica_urls))
+            policy_rows.append(one_policy(policy, replica_urls,
+                                          kv_transfer=kv_transfer,
+                                          label=label))
     finally:
         stop_replicas()
     return {
@@ -835,6 +873,132 @@ def run_fleet_bench(engines, *, sessions=6, turns=4, session_rps=2.0,
         "slo_ttft_ms": float(slo_ttft_ms),
         "num_tokens": int(num_tokens),
         "policies": policy_rows,
+    }
+
+
+def run_kv_pressure_bench(params, model_cfg, tokenizer, *,
+                          ratios=(1, 2, 4), pool_tokens=None,
+                          host_pool_tokens=None, turns=3,
+                          user_len=32, reply_len=8, seed=0,
+                          **engine_overrides):
+    """KV-pressure scenario (``BENCH_KV_PRESSURE=1,2,4``): multi-turn
+    chat with a warm working set N× the device KV pool, tiering OFF vs
+    ON — the capacity-miss traffic shape the host tier exists for.
+
+    Per ratio N, ``sessions ≈ N × pool / session_prefix`` conversations
+    interleave their turns (turn-major order), so by the time a
+    session's next turn arrives its prefix pages have been evicted by
+    the other sessions. With tiering off every such turn re-prefills
+    the whole history; with tiering on the eviction offloaded the pages
+    to host RAM and admission restores them (priced H2D). Headline per
+    arm: **warm_p50_ttft_ms** and **kv_restore_hit_rate** (restoring
+    admissions / prefix lookups) — on hardware the ON arm's warm TTFT
+    must beat OFF at N≥2 (tools/perf_diff.py does not gate this section
+    yet; the acceptance run reads it directly).
+
+    Fresh engine per arm over SHARED params; ``engine_overrides`` let
+    the tier-1 CPU smoke shrink the geometry. The ``KV_HOST_POOL_TOKENS``
+    env var is masked for the duration — the arm matrix IS the knob
+    here."""
+    import statistics
+
+    from generativeaiexamples_tpu.engine import (Engine, EngineConfig,
+                                                 SamplingParams)
+
+    if pool_tokens is None:
+        pool_tokens = int(os.environ.get("BENCH_KV_PRESSURE_POOL", "")
+                          or 2048)
+    pool_tokens = int(pool_tokens)
+    page = int(engine_overrides.get("page_size", 128))
+    # None = derive; an explicit value (including a caller's 0) is kept
+    host_tokens = int((max(ratios) + 1) * pool_tokens
+                      if host_pool_tokens is None else host_pool_tokens)
+    system_len = max(2 * page, pool_tokens // 4)
+    vocab = getattr(model_cfg, "vocab_size", 32000)
+    span = min(vocab - 4, 250)
+
+    def ids(tag: int, n: int) -> list:
+        return [(tag * 131 + 7 * i) % span + 4 for i in range(n)]
+
+    saved_env = os.environ.pop("KV_HOST_POOL_TOKENS", None)
+    sp = SamplingParams(max_tokens=reply_len, top_k=1, ignore_eos=True)
+    arms = []
+    try:
+        for ratio in ratios:
+            sessions = max(2, round(ratio * pool_tokens / system_len))
+            for tiering in (False, True):
+                kw = dict(
+                    max_slots=2,
+                    max_input_length=system_len + turns
+                    * (user_len + reply_len) + 2 * page,
+                    max_output_length=max(16, 2 * reply_len),
+                    prefill_buckets=(512, 1024), dtype="bfloat16",
+                    kv_pool_tokens=pool_tokens,
+                    steps_per_round=int(os.environ.get(
+                        "BENCH_STEPS_PER_ROUND", "16")),
+                    kv_host_pool_tokens=host_tokens if tiering else 0)
+                kw.update(engine_overrides)
+                engine = Engine(params, model_cfg, tokenizer,
+                                EngineConfig(**kw))
+                try:
+                    engine.start()
+                    before = engine.stats
+                    histories = {
+                        s: ids(seed * 7919 + ratio * 100 + s
+                               + (10_000 if tiering else 0), system_len)
+                        for s in range(sessions)}
+                    cold, warm = [], []
+                    for t in range(turns):
+                        for s in range(sessions):
+                            prompt = histories[s] + ids(
+                                (ratio * 131 + s) * 1009 + t + 1,
+                                user_len)
+                            stream = engine.submit(prompt, sp)
+                            stream.text()
+                            (cold if t == 0 else warm).append(
+                                stream.ttft_ms)
+                            histories[s] = prompt + stream.token_ids
+                    after = engine.stats
+
+                    def delta(key):
+                        return after.get(key, 0) - before.get(key, 0)
+
+                    lookups = delta("prefix_cache_lookups")
+                    hit = delta("prefix_cache_hit_tokens")
+                    lookup_toks = delta("prefix_cache_lookup_tokens")
+                    arms.append({
+                        "ratio": int(ratio),
+                        "tiering": bool(tiering),
+                        "sessions": int(sessions),
+                        "cold_p50_ttft_ms": round(
+                            statistics.median(cold), 2) if cold else None,
+                        "warm_p50_ttft_ms": round(
+                            statistics.median(warm), 2) if warm else None,
+                        "kv_restore_hit_rate": round(
+                            delta("kv_tier_restore_hits")
+                            / max(1, lookups), 4),
+                        "kv_tier_offload_pages": int(
+                            delta("kv_tier_offload_pages")),
+                        "kv_tier_restore_pages": int(
+                            delta("kv_tier_restore_pages")),
+                        "kv_restore_skipped_cost": int(
+                            delta("kv_restore_skipped_cost")),
+                        "prefix_hit_rate": round(
+                            hit / lookup_toks, 4) if lookup_toks else 0.0,
+                    })
+                finally:
+                    engine.stop()
+                import gc
+                gc.collect()
+    finally:
+        if saved_env is not None:
+            os.environ["KV_HOST_POOL_TOKENS"] = saved_env
+    return {
+        "pool_tokens": int(pool_tokens),
+        "host_pool_tokens": int(host_tokens),
+        "ratios": [int(r) for r in ratios],
+        "turns": int(turns),
+        "arms": arms,
     }
 
 
@@ -898,7 +1062,8 @@ def assemble_result(*, kind, model, headline, engine_p50, engine_p99, tput,
                     weights, prompt_len, out_len, slots, steps_per_round,
                     kv_pool_pages, device, rtt_ms, n_devices,
                     bench_seconds, e2e_tps_p50=None, openloop=None,
-                    fleet=None, capacity=None, rounds=None) -> dict:
+                    fleet=None, capacity=None, rounds=None,
+                    kv_pressure=None) -> dict:
     """The bench's single output contract. Every field name here is
     pinned by tools/bench_schema.json (validated at emit time AND by the
     tier-1 suite, tests/test_bench_schema.py) so a rename fails fast
@@ -950,6 +1115,10 @@ def assemble_result(*, kind, model, headline, engine_p50, engine_p99, tput,
         # TTFT/throughput/HBM-roofline — the BENCH_SWEEP_rNN table as
         # one validated section. Null when the sweep is not requested.
         "capacity": capacity,
+        # KV-pressure scenario (BENCH_KV_PRESSURE): multi-turn chat at
+        # working sets N× the KV pool, host tiering off vs on — warm
+        # TTFT + restore hit rate per arm. Null when not requested.
+        "kv_pressure": kv_pressure,
         "quantization": quant,
         "kv_quant": kv_quant,
         "weights": weights,
@@ -1345,18 +1514,47 @@ def main() -> None:
         except Exception as exc:  # noqa: BLE001
             sys.stderr.write(f"bench: capacity sweep failed: {exc}\n")
 
+    # KV-pressure scenario (BENCH_KV_PRESSURE=1,2,4): working sets N×
+    # the pool, tiering off vs on. Fresh small engines over the
+    # measured params, main engine stopped. Degrades to null.
+    kv_pressure = None
+    kvp_env = os.environ.get("BENCH_KV_PRESSURE", "")
+    if kvp_env:
+        try:
+            kv_pressure = run_kv_pressure_bench(
+                engine.params, model_cfg, engine.tokenizer,
+                ratios=[int(r) for r in kvp_env.split(",") if r],
+                turns=int(os.environ.get("BENCH_KV_PRESSURE_TURNS", "3")),
+                seed=int(os.environ.get("BENCH_SEED", "0")))
+        except Exception as exc:  # noqa: BLE001
+            sys.stderr.write(f"bench: kv-pressure scenario failed: "
+                             f"{exc}\n")
+
     # Fleet scenario (BENCH_REPLICAS >= 2): the router over N fresh
     # in-process replicas sharing the measured model's params. Runs with
     # the main engine STOPPED (its pool idle) and explicit small replica
     # pools; prewarm's shrink-on-OOM absorbs tight-HBM hosts. Degrades
-    # to fleet=null, never aborts the bench.
+    # to fleet=null, never aborts the bench. BENCH_FLEET_TRANSFER=0
+    # drops the transfer-enabled arm (on by default: the cross-replica
+    # prefix-hit headline needs it).
     fleet = None
     n_rep = int(os.environ.get("BENCH_REPLICAS", "0") or 0)
     if n_rep >= 2:
+        transfer_arm = os.environ.get("BENCH_FLEET_TRANSFER", "1") \
+            not in ("", "0", "false", "off")
         fleet_engines = []
         try:
+            hp_env = os.environ.get("BENCH_FLEET_HOST_POOL_TOKENS", "")
+            if hp_env != "":
+                host_pool = int(hp_env)   # explicit 0 means tier-less
+            elif transfer_arm:
+                host_pool = int(os.environ.get(
+                    "BENCH_FLEET_KV_POOL_TOKENS", "4096")) * 4
+            else:
+                host_pool = 0
             fleet_engines = build_fleet_engines(
-                engine.params, model_cfg, engine.tokenizer, n_rep)
+                engine.params, model_cfg, engine.tokenizer, n_rep,
+                host_pool_tokens=host_pool)
             fleet = run_fleet_bench(
                 fleet_engines,
                 sessions=int(os.environ.get("BENCH_FLEET_SESSIONS", "6")),
@@ -1365,6 +1563,7 @@ def main() -> None:
                     "BENCH_FLEET_SESSION_RPS", "2")),
                 slo_ttft_ms=float(os.environ.get(
                     "BENCH_SLO_TTFT_MS", "2000")),
+                transfer_arm=transfer_arm,
                 seed=int(os.environ.get("BENCH_SEED", "0")))
         except Exception as exc:  # noqa: BLE001
             sys.stderr.write(f"bench: fleet scenario failed: {exc}\n")
@@ -1388,7 +1587,7 @@ def main() -> None:
         chat=chat, e2e_p50=e2e_p50, e2e_dist=e2e_dist,
         e2e_breakdown=e2e_breakdown, e2e_tps_p50=e2e_tps_p50,
         pipeline=pipeline, openloop=openloop, fleet=fleet,
-        capacity=capacity, rounds=rounds,
+        capacity=capacity, rounds=rounds, kv_pressure=kv_pressure,
         quant=quant, kv_quant=engine.cfg.kv_quant or None,
         weights=("real" if os.environ.get("BENCH_MODEL_PATH")
                  else "random-init"),

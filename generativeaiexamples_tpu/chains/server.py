@@ -310,6 +310,13 @@ def create_app(example: BaseExample,
         # dump. Echoed back so callers can correlate without sending one.
         rid = obs_flight.adopt_request_id(request.headers)
 
+        # Cross-replica KV transfer (docs/kv-tiering.md): the fleet
+        # router's placement-miss hint naming a sibling replica that
+        # holds this prompt's prefix pages. Bound into the request
+        # context below so Engine.submit can fetch them — a no-op when
+        # tiering is off or no engine serves this chain.
+        kv_donor = request.headers.get("X-KV-Transfer-From") or None
+
         # Drain gate FIRST: a draining replica admits nothing new (the
         # 429 tells the router/caller to go elsewhere) while the streams
         # already in flight below run to completion.
@@ -383,6 +390,13 @@ def create_app(example: BaseExample,
             context (iterate_in_thread), so the timeline bound here is
             visible to every stage below it — including Engine.submit."""
             token = obs_flight.bind(timeline)
+            kv_token = None
+            if kv_donor is not None:
+                # Lazy import: a chain without an engine never pays for
+                # the engine package. The contextvar rides the same
+                # copied context as the timeline into Engine.submit.
+                from ..engine import kv_tier
+                kv_token = kv_tier.bind_transfer_source(kv_donor)
             timer = obs_metrics.RequestTimer("chain_generate")
             emitted = False
             drain.inc()
@@ -413,6 +427,9 @@ def create_app(example: BaseExample,
             finally:
                 drain.dec()
                 timer.finish()
+                if kv_token is not None:
+                    from ..engine import kv_tier
+                    kv_tier.unbind_transfer_source(kv_token)
                 obs_flight.unbind(token)
                 # Engine-served requests were already completed at the
                 # stream's terminal transition (complete() is idempotent);
@@ -522,6 +539,113 @@ def create_app(example: BaseExample,
             return error_response(500, "search_error", str(exc), rid)
         return web.json_response(result)
 
+    def _tier_engine():
+        """The served engine, or a (status, error-type, message) tuple
+        when the KV-tier control surface cannot work here."""
+        engine = getattr(getattr(example, "llm", None), "engine", None)
+        if engine is None:
+            return None, (404, "no_engine",
+                          "this chain serves no in-process engine")
+        if getattr(engine, "_kv_tier", None) is None:
+            return None, (409, "kv_tier_disabled",
+                          "KV tiering is disabled on this replica "
+                          "(KV_HOST_POOL_TOKENS=0)")
+        return engine, None
+
+    async def kv_pages(request: web.Request) -> web.Response:
+        """``GET /control/kv_pages?hashes=<hex,...>`` — the cross-
+        replica prefix-page transfer donor side (docs/kv-tiering.md):
+        streams the leading requested blocks resident in either tier as
+        one KV-tier blob, size-capped at the engine's transfer page
+        cap. An empty chain answers 200 with an empty blob (0 blocks)
+        — absence is an answer, not an error."""
+        rid = obs_flight.adopt_request_id(request.headers)
+        engine, err = _tier_engine()
+        if err is not None:
+            return error_response(err[0], err[1], err[2], rid)
+        raw = request.query.get("hashes", "")
+        try:
+            hashes = [bytes.fromhex(h) for h in raw.split(",") if h]
+        except ValueError:
+            raise web.HTTPUnprocessableEntity(
+                text="hashes must be comma-separated hex block hashes")
+        if not hashes:
+            raise web.HTTPUnprocessableEntity(
+                text="at least one block hash is required")
+        try:
+            blob, n = await asyncio.wait_for(
+                asyncio.get_running_loop().run_in_executor(
+                    None, engine.export_blob, hashes),
+                timeout=executor_timeout_s)
+        except asyncio.TimeoutError:
+            return error_response(
+                504, "timeout", "kv page export timed out", rid)
+        except EngineError as exc:
+            return error_response(503, "engine_error", str(exc), rid)
+        return web.Response(
+            body=blob, content_type="application/octet-stream",
+            headers={"X-KV-Blocks": str(n), "X-Request-ID": rid})
+
+    async def kv_suspend(request: web.Request) -> web.Response:
+        """``POST /control/kv_suspend`` ``{"text": ...}`` (or
+        ``{"token_ids": [...]}``) — demote an idle conversation's full
+        prefix chain out of both KV tiers into a compact blob the
+        caller stores; ``/control/kv_resume`` re-seeds it later without
+        recompute. 404s when nothing of the chain is cached."""
+        rid = obs_flight.adopt_request_id(request.headers)
+        engine, err = _tier_engine()
+        if err is not None:
+            return error_response(err[0], err[1], err[2], rid)
+        body = await request.json()
+        ids = body.get("token_ids")
+        if ids is None:
+            text = body.get("text", "")
+            if not text:
+                raise web.HTTPUnprocessableEntity(
+                    text="'text' or 'token_ids' is required")
+            ids = engine.tokenizer.encode(text)
+        try:
+            blob = await asyncio.wait_for(
+                asyncio.get_running_loop().run_in_executor(
+                    None, engine.suspend_session, [int(i) for i in ids]),
+                timeout=executor_timeout_s)
+        except asyncio.TimeoutError:
+            return error_response(
+                504, "timeout", "kv suspend timed out", rid)
+        except EngineError as exc:
+            return error_response(503, "engine_error", str(exc), rid)
+        if blob is None:
+            return error_response(
+                404, "not_cached",
+                "no block of this conversation is cached", rid)
+        return web.Response(
+            body=blob, content_type="application/octet-stream",
+            headers={"X-Request-ID": rid})
+
+    async def kv_resume(request: web.Request) -> web.Response:
+        """``POST /control/kv_resume`` with a suspend blob body —
+        re-seeds the session's blocks into the host tier; the next turn
+        of the conversation restores them instead of re-prefilling."""
+        rid = obs_flight.adopt_request_id(request.headers)
+        engine, err = _tier_engine()
+        if err is not None:
+            return error_response(err[0], err[1], err[2], rid)
+        blob = await request.read()
+        try:
+            # Off the event loop like the sibling handlers: parsing an
+            # up-to-100MB blob (byte slices + frombuffer per array)
+            # must never stall in-flight SSE streams or /health.
+            n = await asyncio.wait_for(
+                asyncio.get_running_loop().run_in_executor(
+                    None, engine.resume_session, blob),
+                timeout=executor_timeout_s)
+        except asyncio.TimeoutError:
+            return error_response(
+                504, "timeout", "kv resume timed out", rid)
+        except (EngineError, ValueError) as exc:
+            return error_response(422, "bad_blob", str(exc), rid)
+        return web.json_response({"blocks": n, "request_id": rid})
+
     async def metrics_endpoint(request: web.Request) -> web.Response:
         # Scrape-time engine snapshot: when the example serves an
         # in-process engine (EngineLLM), surface its counters — decode
@@ -557,6 +681,9 @@ def create_app(example: BaseExample,
     app.router.add_post("/documentSearch", document_search)
     app.router.add_post("/control/drain", control_drain)
     app.router.add_post("/control/undrain", control_undrain)
+    app.router.add_get("/control/kv_pages", kv_pages)
+    app.router.add_post("/control/kv_suspend", kv_suspend)
+    app.router.add_post("/control/kv_resume", kv_resume)
     return app
 
 

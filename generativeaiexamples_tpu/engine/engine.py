@@ -74,7 +74,9 @@ from ..utils import faults
 from ..utils.errors import ConfigError, EngineError, SchedulerFullError
 from ..utils.hbm import peak_bw
 from ..utils.logging import get_logger, log_event
+from . import kv_tier as kv_tier_mod
 from .detokenizer import IncrementalDetokenizer, StopWordTrap
+from .kv_tier import BlockRecord, KVTier
 from .prefix_cache import PrefixCache, hash_blocks, usable_prefix_tokens
 from .sampling_params import SamplingParams
 from .scheduler import (OnlineCalibrator, PrefillJob, StepCostModel,
@@ -158,6 +160,21 @@ _STATS_TEMPLATE = {
     "spec_verify_rounds": 0,
     "spec_verify_tokens": 0,
     "spec_verify_slot_steps": 0,
+    # Tiered KV store (engine/kv_tier.py): refcount-0 prefix pages
+    # offloaded to the host-RAM tier instead of dropped at eviction,
+    # pages restored H2D at admission (and admissions that restored
+    # >= 1 page), admissions whose host-tier hit was deliberately
+    # re-prefilled because the step-cost model priced restore more
+    # expensive than recompute, pages imported from a sibling replica
+    # over /control/kv_pages, and blocks moved through session
+    # suspend/resume. All 0 forever with KV_HOST_POOL_TOKENS=0.
+    "kv_tier_offload_pages": 0,
+    "kv_tier_restore_pages": 0,
+    "kv_tier_restore_hits": 0,
+    "kv_restore_skipped_cost": 0,
+    "kv_tier_transfer_pages": 0,
+    "kv_tier_suspended_blocks": 0,
+    "kv_tier_resumed_blocks": 0,
     # Round telemetry (obs/rounds.py): engine rounds whose plan AND
     # every harvested device output have been recorded — the flight-
     # recorder-style per-round records behind GET /debug/rounds.
@@ -178,7 +195,8 @@ def engine_stat_keys() -> tuple[str, ...]:
     return (tuple(_STATS_TEMPLATE)
             + ("dispatch_queue_depth", "sched_prefill_share",
                "spec_acceptance_rate", "spec_tokens_per_step",
-               "sched_cost_drift_ratio")
+               "sched_cost_drift_ratio",
+               "kv_tier_host_pages", "kv_restore_hit_rate")
             + tuple(CacheStats().snapshot()) + ("prefix_cache_pages",))
 
 
@@ -289,6 +307,14 @@ class EngineConfig:
     # single-chip fused sampler contract).
     spec_decode: bool = False
     spec_max_draft_tokens: Optional[int] = None
+    # Tiered KV store (engine/kv_tier.py): host-RAM budget, in tokens,
+    # for refcount-0 prefix pages offloaded at eviction instead of
+    # dropped (restored via priced H2D at admission; also the landing
+    # zone for session resume and cross-replica page transfer). The
+    # KV_HOST_POOL_TOKENS env var beats this field; None defers to it.
+    # 0 (the default) disables the tier entirely — the engine then
+    # byte-for-byte preserves the untiered eviction behavior.
+    kv_host_pool_tokens: Optional[int] = None
 
     def __post_init__(self) -> None:
         # Geometry validation lives on the config, not the engine — a bad
@@ -310,6 +336,11 @@ class EngineConfig:
                 f"max_prefill_bucket={self.max_prefill_bucket} must be a "
                 f"multiple of page_size={self.page_size} (>= one page); "
                 f"pass a smaller page_size to serve finer prefill caps")
+        if self.kv_host_pool_tokens is not None \
+                and self.kv_host_pool_tokens < 0:
+            raise ConfigError(
+                f"kv_host_pool_tokens={self.kv_host_pool_tokens} must "
+                f"be >= 0 (0 disables the host KV tier)")
         if self.spec_max_draft_tokens is not None \
                 and self.spec_max_draft_tokens < 1:
             raise ConfigError(
@@ -599,6 +630,44 @@ class Engine:
         # the serve-loop thread; reset() swaps in a fresh instance.
         self._prefix_cache = (PrefixCache(page) if cfg.prefix_cache
                               else None)
+        # Tiered KV store (engine/kv_tier.py): env beats config beats
+        # the disabled default — with 0 the tier object never exists
+        # and every tier code path below is skipped, preserving the
+        # untiered engine byte-for-byte (pinned by the parity test).
+        env_host = os.environ.get("KV_HOST_POOL_TOKENS", "")
+        host_tokens = (int(env_host) if env_host
+                       else (cfg.kv_host_pool_tokens or 0))
+        self._kv_tier: Optional[KVTier] = None
+        if self._prefix_cache is not None and host_tokens > 0:
+            mcfg = self.model_cfg
+            self._kv_tier = KVTier(
+                page_size=page, host_pool_tokens=host_tokens,
+                bytes_per_token=self._kv_bytes_per_token(),
+                meta={"kv_quant": cfg.kv_quant,
+                      "num_layers": mcfg.num_layers,
+                      "num_kv_heads": mcfg.num_kv_heads,
+                      "head_dim": mcfg.head_dim,
+                      "dtype": cfg.dtype},
+                transfer_max_pages=int(os.environ.get(
+                    "KV_TRANSFER_MAX_PAGES", "32") or 32),
+                transfer_timeout_s=float(os.environ.get(
+                    "KV_TRANSFER_TIMEOUT_S", "5") or 5))
+        # Page gather/scatter programs for the tier (built lazily; jit
+        # re-specializes per padded page-count rung automatically).
+        # _io_rungs tracks scatter rungs already compiled: a rung's
+        # FIRST dispatch pays jit compile inside the measured wall, and
+        # feeding that into the h2d EWMA would price every later
+        # restore as if it compiled too (observed: one cold 32-page
+        # restore taught the calibrator 23 ms/page and the pricing
+        # refused all restores thereafter).
+        self._gather_fn = None
+        self._scatter_fn = None
+        self._io_rungs: set = set()
+        # Control-op queue: suspend/resume/export mutate serve-loop-
+        # owned structures (prefix cache, free pages, device state), so
+        # callers funnel closures here; the loop executes them between
+        # rounds. On a stopped engine they run inline (single-threaded).
+        self._control: "queue.Queue[tuple]" = queue.Queue()
         self._state = self._init_device_state()
         self._base_key = jax.random.key(cfg.seed)
         self._step_counter = itertools.count()
@@ -1186,6 +1255,15 @@ class Engine:
             # by at most one in-flight admission — fine for metrics.
             out.update(cache.stats.snapshot())
             out["prefix_cache_pages"] = cache.cached_pages
+        # KV tier (engine/kv_tier.py): live host-store occupancy and the
+        # restore-hit rate — what fraction of prefix lookups the host
+        # tier turned into restored pages instead of recompute.
+        tier = self._kv_tier
+        out["kv_tier_host_pages"] = tier.store.pages if tier else 0
+        lookups = out.get("prefix_cache_lookups", 0)
+        out["kv_restore_hit_rate"] = (
+            round(out["kv_tier_restore_hits"] / lookups, 4)
+            if lookups else 0.0)
         return out
 
     def _bump(self, key: str, n: int = 1) -> None:
@@ -1897,6 +1975,13 @@ class Engine:
         # (see _harvest_worker) can never corrupt the new count.
         self._harvest_q = queue.Queue()
         self._completed = queue.Queue()
+        # Control ops queued against the dead generation must fail NOW
+        # (not hang out the 30 s wait) and must never execute against
+        # the rebuilt state — a stale suspend would demote a fresh
+        # cache. Fresh queue for the same disowned-thread reason as the
+        # pipeline queues above.
+        self._fail_control_ops("engine was reset")
+        self._control = queue.Queue()
         with self._pipe_lock:
             self._inflight_rounds = 0
         self._slots.clear()
@@ -1960,6 +2045,9 @@ class Engine:
         self._harvest_q = queue.Queue()
         with self._pipe_lock:
             self._inflight_rounds = 0
+        # Queued control ops (suspend/export) will never run — fail
+        # their waiters instead of leaving them to the wait timeout.
+        self._fail_control_ops("engine stopped")
         # Deactivate every occupied device slot FIRST: a host-detected
         # finish pending in _completed never had its device release
         # dispatched, and retiring it below removes the slot from _slots
@@ -1983,6 +2071,18 @@ class Engine:
                 self._retire(req, "cancelled")
             elif not req.done:
                 req.stream._finish("cancelled")
+
+    def _fail_control_ops(self, reason: str) -> None:
+        """Fail every queued control op's waiter (stop/reset paths —
+        the ops will never run, and must neither hang their callers out
+        the wait timeout nor execute later against rebuilt state)."""
+        while True:
+            try:
+                _fn, box, ev = self._control.get_nowait()
+            except queue.Empty:
+                return
+            box["error"] = EngineError(reason)
+            ev.set()
 
     def __enter__(self) -> "Engine":
         self.start()
@@ -2331,6 +2431,11 @@ class Engine:
                 prompt_ids, ngram_max=self._spec.ngram_max,
                 ngram_min=self._spec.ngram_min)
             req.spec_ctrl = AdaptiveDraftController(self._spec)
+        if self._kv_tier is not None:
+            # Cross-replica prefix-page import (router placement-miss
+            # hint): bounded network fetch on the CALLER's thread, so
+            # the serve loop never does I/O; failures place cold.
+            self._transfer_prefetch(req)
         self._enqueue(req, params, stream)
         if self._fatal is not None:
             # The loop may have died between the check above and the put;
@@ -2413,6 +2518,384 @@ class Engine:
                 req.cache_refs.append(hashes[i])
                 req.cache_pages.add(req.pages[i])
 
+    # ---------------------------------------------------- tiered KV store
+
+    def _page_io_fns(self):
+        """Lazily-built page gather/scatter programs over the paged
+        pool. Gather reads selected pages out of the live cache (the
+        D2H offload source; non-donating — the pool stays valid);
+        scatter writes page-shaped host data into selected pages (the
+        H2D restore sink; donates the state like every other state
+        transition). Both take a padded page-index vector (power-of-two
+        rungs, padded with the trash page 0) so jit specializes per
+        rung, not per count."""
+        if self._gather_fn is None:
+            def gather(cache, idx):
+                return {k: v[:, idx] for k, v in cache.items()}
+
+            def scatter(state, arrays, idx):
+                cache = {k: v.at[:, idx].set(arrays[k].astype(v.dtype))
+                         for k, v in state["cache"].items()}
+                return dict(state, cache=self._pin_cache(cache))
+
+            self._gather_fn = jax.jit(gather)
+            self._scatter_fn = jax.jit(scatter, donate_argnums=(0,))
+        return self._gather_fn, self._scatter_fn
+
+    @staticmethod
+    def _pad_pages(pages) -> np.ndarray:
+        """Pad a page-id list to the next power-of-two rung with the
+        trash page (0): gathers of page 0 are discarded host-side,
+        scatters into it land on the designated garbage page."""
+        n = max(1, len(pages))
+        m = 1
+        while m < n:
+            m *= 2
+        return np.asarray(list(pages) + [0] * (m - len(pages)), np.int32)
+
+    def _offload_victims(self, victims: list, rec=None) -> None:
+        """Offload evicted refcount-0 prefix pages to the host tier:
+        one gather dispatch over the victim pages (device FIFO order
+        guarantees it reads the pages BEFORE any later dispatch of this
+        or another admission overwrites them), async D2H started here,
+        materialized into the host store by the harvest worker — the
+        blocking copy never runs on the scheduling path. Any failure
+        (including an injected ``kv.offload`` fault) degrades to the
+        untiered behavior: the pages are simply dropped."""
+        tier = self._kv_tier
+        if tier is None or not victims:
+            return
+        try:
+            faults.inject("kv.offload")
+            fresh = [(h, par, pg) for h, par, pg in victims
+                     if not tier.store.has(h)]
+            if not fresh:
+                return
+            gather, _ = self._page_io_fns()
+            idx = self._pad_pages([pg for _, _, pg in fresh])
+            rung_warm = ("gather", len(idx)) in self._io_rungs
+            self._guard_live()
+            arrays = gather(self._state["cache"], jnp.asarray(idx))
+            self._io_rungs.add(("gather", len(idx)))
+            for a in arrays.values():
+                try:
+                    a.copy_to_host_async()
+                except Exception:  # noqa: BLE001 — optional fast path
+                    pass
+            self._harvest_q.put((
+                "offload", [(h, par) for h, par, _ in fresh], arrays,
+                rung_warm))
+            if rec is not None:
+                # D2H traffic term: the offloaded pages cross HBM once.
+                rec.hbm_bytes += len(fresh) * self.cfg.page_size \
+                    * self._kv_bytes_per_token()
+        except _StaleLoop:
+            raise
+        except Exception:  # noqa: BLE001 — offload is best-effort
+            logger.debug("kv offload failed; pages dropped", exc_info=True)
+
+    def _plan_restore(self, req: _Request, hashes: list,
+                      k_use: int) -> list:
+        """Host-tier half of admission lookup: the contiguous chain
+        continuation ``hashes[k_use:]`` present in the host store,
+        COW-capped like any other prefix match, and PRICED — the
+        restore only happens when the step-cost model says uploading
+        the pages beats recomputing their tokens (refusals are counted
+        in ``kv_restore_skipped_cost``). Returns the block records to
+        restore (possibly shorter than planned if the store's LRU raced
+        us)."""
+        tier = self._kv_tier
+        page = self.cfg.page_size
+        avail = tier.store.match_chain(hashes[k_use:])
+        if not avail:
+            return []
+        usable = usable_prefix_tokens(k_use + avail, len(req.prompt_ids),
+                                      page) // page
+        r = usable - k_use
+        if r <= 0:
+            return []
+        if not self._sched.cost.restore_cheaper(r, page):
+            self._bump("kv_restore_skipped_cost")
+            return []
+        recs = []
+        for h in hashes[k_use:k_use + r]:
+            rec = tier.store.get(h)
+            if rec is None:
+                break  # LRU raced: restore the contiguous prefix we hold
+            recs.append(rec)
+        return recs
+
+    def _restore_blocks(self, req: _Request, hashes: list, k_use: int,
+                        recs: list, rec=None) -> int:
+        """Upload host-tier blocks into this request's freshly
+        allocated pages — ONE scatter dispatch, enqueued ahead of the
+        scheduler's prefill-chunk grants (device FIFO), so by the time
+        the first chunk's attention reads the prefix back it is
+        resident. The restored blocks enter the prefix cache exactly
+        like freshly prefilled ones (one ref held by this request)."""
+        tier = self._kv_tier
+        page = self.cfg.page_size
+        r = len(recs)
+        t0 = time.monotonic()
+        faults.inject("kv.restore")
+        arrays = tier.stack_blocks(recs)          # name -> (L, r, ...)
+        pages = req.pages[k_use:k_use + r]
+        idx = self._pad_pages(pages)
+        pad = len(idx) - r
+        if pad:
+            arrays = {k: np.concatenate(
+                [v, np.zeros(v.shape[:1] + (pad,) + v.shape[2:],
+                             v.dtype)], axis=1)
+                for k, v in arrays.items()}
+        _, scatter = self._page_io_fns()
+        rung_warm = ("scatter", len(idx)) in self._io_rungs
+        self._guard_live()
+        new_state = scatter(
+            self._state, {k: jnp.asarray(v) for k, v in arrays.items()},
+            jnp.asarray(idx))
+        self._guard_live()
+        self._state = new_state
+        self._io_rungs.add(("scatter", len(idx)))
+        dt = time.monotonic() - t0
+        if self._calib is not None and rung_warm:
+            # Host wall of build+upload dispatch per page: on async
+            # backends this under-counts on-device copy time, but it IS
+            # the serve-loop cost the admission decision trades against
+            # recompute dispatch cost (docs/kv-tiering.md, pricing).
+            # First-use rungs are excluded — their wall is dominated by
+            # the one-time jit compile, not the transfer.
+            self._calib.observe_h2d(r, dt * 1e3)
+        record_stage("engine_kv_restore", dt)
+        tl = req.stream.timeline
+        if tl is not None:
+            tl.stage("engine_kv_restore", dt)
+        for i, pg in enumerate(pages):
+            h = hashes[k_use + i]
+            parent = hashes[k_use + i - 1] if (k_use + i) else None
+            if self._prefix_cache.insert(h, parent, pg):
+                req.cache_refs.append(h)
+                req.cache_pages.add(pg)
+        with self._stats_lock:
+            self._stats["kv_tier_restore_pages"] += r
+            self._stats["kv_tier_restore_hits"] += 1
+        if rec is not None:
+            rec.kv_restore_pages += r
+            rec.hbm_bytes += r * page * self._kv_bytes_per_token()
+        return r
+
+    def _transfer_prefetch(self, req: _Request) -> None:
+        """Cross-replica prefix-page import, on the SUBMITTING thread
+        (like bad-words compilation — the serve loop never does network
+        I/O): when the router hinted a donor via ``X-KV-Transfer-From``
+        (bound to the request context by the chain server), fetch the
+        prompt-head blocks missing from the host tier from the donor's
+        ``/control/kv_pages``. Bounded + best-effort: any failure or
+        timeout places cold."""
+        tier = self._kv_tier
+        src = kv_tier_mod.current_transfer_source()
+        if tier is None or src is None or not req.prompt_ids:
+            return
+        if not kv_tier_mod.donor_allowed(src):
+            # The hint header is client-suppliable on a directly-hit
+            # replica: when KV_TRANSFER_ALLOW scopes donors, anything
+            # outside it is ignored — no fetch, no SSRF surface.
+            logger.warning("kv transfer: donor %s not in "
+                           "KV_TRANSFER_ALLOW; ignoring hint", src)
+            return
+        if req.block_hashes is None:
+            req.block_hashes = hash_blocks(req.prompt_ids,
+                                           self.cfg.page_size)
+        missing = [h for h in req.block_hashes[:tier.transfer_max_pages]
+                   if not tier.store.has(h)]
+        if not missing:
+            return
+        got = kv_tier_mod.fetch_blocks(
+            src, missing, timeout_s=tier.transfer_timeout_s,
+            max_pages=tier.transfer_max_pages)
+        if not got:
+            return
+        meta, records = got
+        if not tier.compatible(meta):
+            logger.warning("kv transfer: donor %s pool geometry does not "
+                           "match; ignoring payload", src)
+            return
+        # Only blocks we ASKED for may land: the content address is this
+        # prompt's own hash chain, so an answer naming any other hash is
+        # either a donor bug or an attempt to poison unrelated cached
+        # prefixes through the shared host store — dropped either way.
+        wanted = set(missing)
+        n = sum(1 for record in records
+                if record.hash in wanted and tier.store.put(record))
+        if n:
+            self._bump("kv_tier_transfer_pages", n)
+            tl = req.stream.timeline
+            if tl is not None:
+                tl.annotate(kv_transfer_pages=n)
+
+    # ------------------------------------------------ control operations
+
+    def _drain_control(self) -> bool:
+        """Execute queued control closures (suspend/export) on the serve
+        loop, between rounds — they touch scheduler-owned structures
+        (prefix cache, free pages, device state) that must never see a
+        second thread."""
+        did = False
+        while True:
+            try:
+                fn, box, ev = self._control.get_nowait()
+            except queue.Empty:
+                return did
+            did = True
+            try:
+                box["result"] = fn()
+            except BaseException as exc:  # noqa: BLE001 — relayed to caller
+                box["error"] = exc
+            finally:
+                ev.set()
+
+    def _run_control(self, fn, timeout: float = 30.0):
+        """Run ``fn`` on the serve loop (queued; bounded wait) — or
+        inline when the loop is not running (construction-time and
+        stopped engines are single-threaded by contract)."""
+        thread = self._thread
+        if thread is None or not thread.is_alive():
+            return fn()
+        box: dict = {}
+        ev = threading.Event()
+        self._control.put((fn, box, ev))
+        self._wake.set()
+        if not ev.wait(timeout):
+            raise EngineError("engine control op timed out")
+        if "error" in box:
+            raise box["error"]
+        return box.get("result")
+
+    def _collect_blocks(self, hashes: list, start: int, stop: int,
+                        into_store: bool = True) -> list:
+        """Serve-loop body of export/suspend: walk the chain slice
+        ``[start, stop)``, pulling each block from the host tier or
+        gathering it out of HBM (one batched gather + blocking readback
+        — a control op, off the token path). Callers BATCH long chains
+        across control ops so decode rounds interleave between slices
+        (one uncapped readback would stall every live stream). Stops at
+        the first block resident in neither tier; chained hashes make a
+        gapped chain useless anyway."""
+        tier = self._kv_tier
+        out: list = []
+        gather_meta: list = []   # (out index, hash, parent, page)
+        for i in range(start, min(stop, len(hashes))):
+            h = hashes[i]
+            rec = tier.store.peek(h)
+            if rec is not None:
+                out.append(rec)
+                continue
+            pg = (self._prefix_cache.page_of(h)
+                  if self._prefix_cache is not None else None)
+            if pg is None:
+                break
+            parent = hashes[i - 1] if i else None
+            gather_meta.append((len(out), h, parent, pg))
+            out.append(None)
+        if gather_meta:
+            gather, _ = self._page_io_fns()
+            idx = self._pad_pages([pg for _, _, _, pg in gather_meta])
+            arrays = gather(self._state["cache"], jnp.asarray(idx))
+            host = {k: np.asarray(v) for k, v in arrays.items()}
+            records = KVTier.split_pages(
+                host, [(h, par) for _, h, par, _ in gather_meta])
+            for (slot_i, _, _, _), record in zip(gather_meta, records):
+                out[slot_i] = record
+                if into_store:
+                    # Exporting is free warming: the gathered block now
+                    # also lives in the host tier.
+                    tier.store.put(record)
+        return [r for r in out if r is not None]
+
+    def export_blob(self, hashes: Sequence[bytes],
+                    max_blocks: Optional[int] = None
+                    ) -> tuple[bytes, int]:
+        """Serialize the leading cached blocks of a hash chain for a
+        peer replica (the ``GET /control/kv_pages`` payload). Returns
+        ``(blob, n_blocks)`` — n may be 0 (empty blob) when nothing of
+        the chain is resident in either tier. Size-capped at the
+        transfer page cap."""
+        if self._kv_tier is None:
+            raise EngineError(
+                "KV tiering is disabled (KV_HOST_POOL_TOKENS=0)")
+        tier = self._kv_tier
+        cap = int(max_blocks or tier.transfer_max_pages)
+        chain = list(hashes)
+        recs = self._run_control(
+            lambda: self._collect_blocks(chain, 0, cap))
+        # Serialization happens HERE, on the caller's thread — the
+        # serve loop only gathers.
+        return kv_tier_mod.to_blob(recs, tier.meta), len(recs)
+
+    def suspend_session(self, token_ids: Sequence[int]
+                        ) -> Optional[bytes]:
+        """Demote an idle conversation's full prefix chain out of BOTH
+        tiers into a compact blob (engine/kv_tier.py wire format).
+        HBM pages return to the free list; host copies are dropped.
+        Blocks still referenced by live requests — or shared as
+        interior blocks of another resident chain — stay put (they are
+        exported into the blob regardless, so resume is complete).
+        Returns None when nothing of the chain is cached."""
+        if self._kv_tier is None:
+            raise EngineError(
+                "KV tiering is disabled (KV_HOST_POOL_TOKENS=0)")
+        ids = list(token_ids)
+        tier = self._kv_tier
+        page = self.cfg.page_size
+        hashes = hash_blocks(ids, page)
+        # Collect in transfer-cap slices, one control op each: decode
+        # rounds interleave between slices, so a long conversation's
+        # suspend never stalls live streams for its whole readback.
+        records: list = []
+        step = max(1, tier.transfer_max_pages)
+        for lo in range(0, len(hashes), step):
+            batch = self._run_control(
+                lambda lo=lo: self._collect_blocks(
+                    hashes, lo, lo + step, into_store=False))
+            records.extend(batch)
+            if len(batch) < min(step, len(hashes) - lo):
+                break   # chain ended mid-slice
+        if not records:
+            return None
+        n = len(records)
+
+        def demote():
+            for h in reversed(hashes[:n]):   # leaf-first
+                tier.store.pop(h)
+                pg = self._prefix_cache.remove(h)
+                if pg is not None:
+                    self._free_pages.append(pg)
+            with self._stats_lock:
+                self._stats["kv_tier_suspended_blocks"] += n
+        self._run_control(demote)
+        # Blob assembly off the serve loop, on the caller's thread.
+        return kv_tier_mod.to_blob(records, tier.meta)
+
+    def resume_session(self, blob: bytes) -> int:
+        """Re-seed a suspended session's blocks into the HOST tier (no
+        device work — the next admission of the conversation restores
+        them through the normal priced H2D path). Returns the number of
+        blocks accepted. Raises EngineError on a geometry mismatch —
+        silently loading another model's KV would serve garbage."""
+        if self._kv_tier is None:
+            raise EngineError(
+                "KV tiering is disabled (KV_HOST_POOL_TOKENS=0)")
+        try:
+            meta, records = kv_tier_mod.from_blob(blob)
+        except (ValueError, KeyError, TypeError) as exc:
+            raise EngineError(f"malformed KV blob: {exc}") from exc
+        if not self._kv_tier.compatible(meta):
+            raise EngineError(
+                f"KV blob geometry does not match this engine (blob "
+                f"{meta!r} vs engine {self._kv_tier.meta!r})")
+        n = sum(1 for rec in records if self._kv_tier.store.put(rec))
+        self._bump("kv_tier_resumed_blocks", n)
+        return n
+
     def _run(self) -> None:
         """Scheduler thread: retire completions, then execute ROUND PLANS
         from the token-budget scheduler — each iteration dispatches at
@@ -2440,6 +2923,7 @@ class Engine:
                 if did_drain:
                     record_stage("loop_drain", t1 - t0)
                 self._pull_pending()
+                did_work |= self._drain_control()
                 did_work |= self._cull_backlog()
                 # Online calibration: fold any new measured-round
                 # evidence into the planning model BEFORE this round is
@@ -2539,6 +3023,32 @@ class Engine:
                         return
                     self.rounds.complete_part(rec,
                                               harvest_wait_ms=wait * 1e3)
+                    self._wake.set()
+                    continue
+                if kind == "offload":
+                    # Evicted prefix pages on their way to the host
+                    # tier: materialize the gather's async D2H copies
+                    # here, OFF the scheduling path, and park them in
+                    # the content-addressed host store.
+                    _, metas, dev_arrays, rung_warm = item
+                    host = {k: np.asarray(v)
+                            for k, v in dev_arrays.items()}
+                    wait = time.monotonic() - t0
+                    if self._gen != gen:
+                        return
+                    tier = self._kv_tier
+                    if tier is not None:
+                        for block in KVTier.split_pages(host, metas):
+                            tier.store.put(block)
+                        if self._calib is not None and rung_warm:
+                            # First-use gather rungs are excluded like
+                            # the scatter side: their wait is dominated
+                            # by the one-time jit compile. (Steady
+                            # state, the async copy often lands before
+                            # the pop — the wait is a floor estimate.)
+                            self._calib.observe_d2h(len(metas),
+                                                    wait * 1e3)
+                        self._bump("kv_tier_offload_pages", len(metas))
                     self._wake.set()
                     continue
                 if kind == "first":
@@ -2889,7 +3399,7 @@ class Engine:
             if req.slot < 0:
                 if not self._free_slots:
                     break
-                ok = self._begin_prefill(req)
+                ok = self._begin_prefill(req, rec)
                 if ok is None:     # dropped (cancel raced the grant)
                     continue
                 if not ok:         # pool backpressure: stop admitting
@@ -2954,6 +3464,11 @@ class Engine:
             else:
                 modeled += cost.decode_round_ms(decode_steps)
         modeled += prefill_tokens * cost.prefill_ms_per_token
+        # In-flight H2D: restored pages ride the round's device queue
+        # ahead of the chunk grants — priced so the drift gauge stays
+        # truthful on restore-heavy rounds (0 until h2d is measured).
+        if rec.kv_restore_pages:
+            modeled += cost.restore_ms(rec.kv_restore_pages)
         return modeled
 
     def _on_round_complete(self, rec) -> None:
@@ -3006,13 +3521,14 @@ class Engine:
             logger.debug("round completion accounting failed",
                          exc_info=True)
 
-    def _begin_prefill(self, req: _Request):
+    def _begin_prefill(self, req: _Request, rec=None):
         """Admission half 1: allocate the slot and pages, take prefix-
         cache refs, and build the dispatch context the chunk programs
         share. Returns True on success, False on pool backpressure (the
         request stays in the backlog; the caller stops admitting this
         round — pool pressure is global), None when the request was
-        dropped instead of admitted."""
+        dropped instead of admitted. ``rec``: this round's telemetry
+        record — KV-tier offload/restore traffic is attributed to it."""
         if req.stream.cancelled:
             self._backlog = [e for e in self._backlog if e[0] is not req]
             req.stream._finish("cancelled")
@@ -3023,16 +3539,28 @@ class Engine:
         # this prompt read-only (refs taken NOW so pool-pressure
         # eviction below can't reclaim it out from under us).
         hashes, k_use, hit_pages = self._prefix_lookup(req)
-        start_tok = k_use * self.cfg.page_size
+        # Host tier: plan the priced restore of the chain's continuation
+        # BEFORE eviction (the records are materialized host-side now,
+        # so this admission's own offloads can't LRU them away).
+        restore_recs: list = []
+        if self._kv_tier is not None and req.rag is None and hashes:
+            restore_recs = self._plan_restore(req, hashes, k_use)
         need_new = n_alloc - k_use
         if need_new > len(self._free_pages):
             # Pool pressure: reclaim retired requests' warm prefix
             # pages (refcount 0, LRU leaf-first) before declaring
             # backpressure — the cache borrows pool pages, it never
-            # shrinks serving capacity.
+            # shrinks serving capacity. With the host tier enabled the
+            # victims are OFFLOADED (async D2H) instead of dropped.
             if self._prefix_cache is not None:
+                victims: list = []
+                sink = None
+                if self._kv_tier is not None:
+                    sink = (lambda h, e:
+                            victims.append((h, e.parent, e.page)))
                 self._free_pages.extend(self._prefix_cache.evict(
-                    need_new - len(self._free_pages)))
+                    need_new - len(self._free_pages), sink=sink))
+                self._offload_victims(victims, rec)
             if need_new > len(self._free_pages):
                 if k_use:
                     self._prefix_cache.release(hashes[:k_use])
@@ -3044,6 +3572,22 @@ class Engine:
                                  for _ in range(need_new)]
         req.cache_refs = list(hashes[:k_use])
         req.cache_pages = set(hit_pages)
+        restored = 0
+        if restore_recs:
+            try:
+                restored = self._restore_blocks(req, hashes, k_use,
+                                                restore_recs, rec)
+            except _StaleLoop:
+                raise
+            except Exception:  # noqa: BLE001 — fall back to recompute
+                # The allocated pages hold garbage at worst; prefill
+                # recomputes straight over them from the HBM-hit
+                # boundary — token-identical, just slower (pinned by
+                # the kv.restore chaos test).
+                logger.warning("kv restore failed; recomputing prefix",
+                               exc_info=True)
+                restored = 0
+        start_tok = (k_use + restored) * self.cfg.page_size
         req.proj_pos = len(req.prompt_ids)
         req.pf_pos = start_tok
         row = np.zeros((self._pmax,), np.int32)

@@ -1,0 +1,370 @@
+"""Tiered KV store: a host-RAM page tier underneath the prefix cache.
+
+At fleet scale the warm-conversation working set dwarfs HBM: the prefix
+cache (engine/prefix_cache.py) keeps retired prompts' KV pages resident
+at refcount 0, but the pool is the only capacity budget, so a capacity
+miss *discards* the pages and the next turn pays a full re-prefill.
+This module is the second tier — the Mooncake (Qin et al., 2024) /
+CachedAttention (Gao et al., ATC 2024) recipe adapted to this engine:
+
+- **Offload instead of drop.** Under pool pressure the engine evicts
+  refcount-0 prefix pages exactly as before, but their KV is first
+  gathered out of the pool (one async D2H per eviction batch, harvested
+  off the scheduling path) and parked here, in a bounded host-RAM store
+  (``KV_HOST_POOL_TOKENS``) keyed by the SAME chained block hash the
+  prefix cache uses — the content address is tier-independent.
+- **Priced restore.** At admission, a hash chain that misses HBM but
+  hits this store is restored via async H2D ahead of the scheduler's
+  chunk grants — but only when the step-cost model (extended with
+  measured ``h2d_ms_per_page`` / ``d2h_ms_per_page``, calibrated online
+  like every other component) prices the restore cheaper than simply
+  recomputing those tokens; otherwise the engine deliberately
+  re-prefills and says so (``kv_restore_skipped_cost``).
+- **Suspend/resume.** The same per-block serialization demotes an idle
+  conversation's whole prefix chain out of BOTH tiers into a compact
+  blob (``Engine.suspend_session``) that ``Engine.resume_session`` can
+  re-seed into the host tier later — no recompute on resume.
+- **Cross-replica transfer.** ``fetch_blocks`` pulls missing blocks
+  from a sibling replica's ``GET /control/kv_pages`` endpoint (the
+  router hints the donor via ``X-KV-Transfer-From`` on a placement
+  miss), turning the fleet's N caches into one. The fetch is bounded
+  (thread + timeout — a hung donor costs a cold prefill, never a stuck
+  request) and size-capped on both sides.
+
+This module is deliberately jax-free at import time: the store, the
+wire format, and the transfer client are host-side numpy/stdlib code
+(the chain server imports the transfer contextvar without paying for an
+engine). The engine owns the device half (gather/scatter programs).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from contextvars import ContextVar
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..utils import faults
+from ..utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+#: Wire/blob format magic + version. Bumped on any layout change; a
+#: reader rejects unknown versions loudly instead of mis-slicing bytes.
+BLOB_MAGIC = b"GAIEKV1\n"
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """Resolve a dtype name back to numpy, including the ml_dtypes
+    extension types jax KV pools use (``bfloat16``)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+@dataclass
+class BlockRecord:
+    """One cached block's KV, page-shaped: per pool leaf (``k``/``v``,
+    plus ``ks``/``vs`` under int8-KV) the page's slice with the page
+    axis removed — ``(L, KV, page, hd)`` for k/v. ``hash`` is the
+    chained block hash (prefix_cache.hash_blocks), the content address
+    in every tier."""
+
+    hash: bytes
+    parent: Optional[bytes]
+    arrays: dict = field(default_factory=dict)   # name -> np.ndarray
+
+    @property
+    def nbytes(self) -> int:
+        return sum(int(a.nbytes) for a in self.arrays.values())
+
+
+class HostPageStore:
+    """Bounded, LRU host-RAM store of :class:`BlockRecord`, keyed by
+    chained block hash. The capacity is BYTES — the actual host-RAM
+    contract ``KV_HOST_POOL_TOKENS`` promises — so an imported blob
+    (resume, cross-replica transfer) with inflated array shapes can
+    never blow past the budget by smuggling oversized records behind a
+    record count. Thread-safe: written by the engine's harvest worker
+    (offload materialization) and chain worker threads (transfer
+    imports, resume), read by the serve loop (restore lookups)."""
+
+    def __init__(self, capacity_bytes: int):
+        self.capacity_bytes = max(0, int(capacity_bytes))
+        self._lock = threading.Lock()
+        self._blocks: dict[bytes, BlockRecord] = {}   # insertion order = LRU
+        self._bytes = 0
+        self.offload_evictions = 0   # records dropped to stay under cap
+
+    @property
+    def pages(self) -> int:
+        with self._lock:
+            return len(self._blocks)
+
+    @property
+    def nbytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def has(self, h: bytes) -> bool:
+        with self._lock:
+            return h in self._blocks
+
+    def put(self, rec: BlockRecord) -> bool:
+        """Insert (or refresh) one block; evicts LRU records past the
+        byte capacity. Returns False when the record cannot fit at all
+        (disabled store, or a single record over the whole budget —
+        evicting everything for one oversized import is never right)."""
+        size = rec.nbytes
+        if self.capacity_bytes <= 0 or size > self.capacity_bytes:
+            return False
+        with self._lock:
+            old = self._blocks.pop(rec.hash, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+            self._blocks[rec.hash] = rec
+            self._bytes += size
+            while self._bytes > self.capacity_bytes:
+                victim = self._blocks.pop(next(iter(self._blocks)))
+                self._bytes -= victim.nbytes
+                self.offload_evictions += 1
+        return True
+
+    def get(self, h: bytes) -> Optional[BlockRecord]:
+        """Fetch one block, refreshing its LRU recency."""
+        with self._lock:
+            rec = self._blocks.pop(h, None)
+            if rec is not None:
+                self._blocks[h] = rec
+            return rec
+
+    def peek(self, h: bytes) -> Optional[BlockRecord]:
+        """Fetch without touching recency (export/suspend walks)."""
+        with self._lock:
+            return self._blocks.get(h)
+
+    def pop(self, h: bytes) -> Optional[BlockRecord]:
+        with self._lock:
+            rec = self._blocks.pop(h, None)
+            if rec is not None:
+                self._bytes -= rec.nbytes
+            return rec
+
+    def match_chain(self, hashes: Sequence[bytes]) -> int:
+        """Longest contiguous run of ``hashes`` (from index 0) present.
+        Chained hashes make any gap a hard stop — the same trie-descent
+        rule the prefix cache's ``match`` applies in HBM."""
+        n = 0
+        with self._lock:
+            for h in hashes:
+                if h not in self._blocks:
+                    break
+                n += 1
+        return n
+
+
+# ---------------------------------------------------------------- wire format
+
+def to_blob(records: Sequence[BlockRecord], meta: dict) -> bytes:
+    """Serialize blocks + geometry meta into one compact blob: a JSON
+    header (hashes, per-array dtype/shape) followed by the raw
+    little-endian array bytes in header order. The format doubles as
+    the suspend/resume blob AND the ``/control/kv_pages`` transfer
+    payload — one wire contract, one parser."""
+    header = {"meta": dict(meta), "blocks": []}
+    payload = bytearray()
+    for rec in records:
+        arrays = {}
+        for name in sorted(rec.arrays):
+            arr = np.ascontiguousarray(rec.arrays[name])
+            arrays[name] = {"dtype": arr.dtype.name,
+                            "shape": list(arr.shape)}
+            payload += arr.tobytes()
+        header["blocks"].append({
+            "hash": rec.hash.hex(),
+            "parent": rec.parent.hex() if rec.parent else None,
+            "arrays": arrays,
+        })
+    head = json.dumps(header).encode("utf-8")
+    return BLOB_MAGIC + len(head).to_bytes(8, "little") + head \
+        + bytes(payload)
+
+
+def from_blob(blob: bytes) -> tuple[dict, list[BlockRecord]]:
+    """Parse :func:`to_blob` output; raises ValueError on anything that
+    is not a well-formed v1 blob (truncation included — a short read
+    must fail loudly, never hand back silently-garbled KV)."""
+    if not blob.startswith(BLOB_MAGIC):
+        raise ValueError("not a KV-tier blob (bad magic)")
+    off = len(BLOB_MAGIC)
+    head_len = int.from_bytes(blob[off:off + 8], "little")
+    off += 8
+    header = json.loads(blob[off:off + head_len].decode("utf-8"))
+    off += head_len
+    records: list[BlockRecord] = []
+    for b in header["blocks"]:
+        arrays: dict[str, np.ndarray] = {}
+        for name, spec in b["arrays"].items():
+            dtype = _np_dtype(spec["dtype"])
+            shape = tuple(spec["shape"])
+            n = int(np.prod(shape)) * dtype.itemsize
+            if off + n > len(blob):
+                raise ValueError("truncated KV-tier blob")
+            arrays[name] = np.frombuffer(
+                blob[off:off + n], dtype=dtype).reshape(shape)
+            off += n
+        records.append(BlockRecord(
+            hash=bytes.fromhex(b["hash"]),
+            parent=(bytes.fromhex(b["parent"]) if b["parent"] else None),
+            arrays=arrays))
+    return header["meta"], records
+
+
+# ------------------------------------------------------------------- the tier
+
+class KVTier:
+    """The engine-side handle: the host store plus geometry metadata
+    (what a peer/resume blob must match to be loadable) and the numpy
+    stack/split helpers the device gather/scatter programs pair with."""
+
+    def __init__(self, *, page_size: int, host_pool_tokens: int,
+                 bytes_per_token: int, meta: dict,
+                 transfer_max_pages: int = 32,
+                 transfer_timeout_s: float = 5.0):
+        self.page_size = int(page_size)
+        self.host_pool_tokens = int(host_pool_tokens)
+        self.meta = dict(meta)
+        self.meta["page_size"] = self.page_size
+        self.transfer_max_pages = int(transfer_max_pages)
+        self.transfer_timeout_s = float(transfer_timeout_s)
+        # Token budget -> the byte budget it actually means: the
+        # engine's pooled KV bytes per token (quantized pools included).
+        self.store = HostPageStore(self.host_pool_tokens
+                                   * max(1, int(bytes_per_token)))
+
+    def compatible(self, meta: dict) -> bool:
+        """Whether a blob's geometry matches this engine's pools — the
+        keys that decide byte layout, nothing cosmetic."""
+        return all(meta.get(k) == self.meta.get(k)
+                   for k in ("page_size", "kv_quant", "num_layers",
+                             "num_kv_heads", "head_dim", "dtype"))
+
+    @staticmethod
+    def stack_blocks(records: Sequence[BlockRecord]) -> dict:
+        """Stack per-block arrays back into gather/scatter layout:
+        name -> (L, n_blocks, ...) with the page axis restored at 1."""
+        names = sorted(records[0].arrays)
+        return {name: np.stack([r.arrays[name] for r in records], axis=1)
+                for name in names}
+
+    @staticmethod
+    def split_pages(arrays: dict, metas: Sequence[tuple]) -> list:
+        """Inverse of :meth:`stack_blocks`: slice a harvested gather
+        result (name -> (L, n_padded, ...)) back into per-block
+        records. Each slice is copied out so a single retained block
+        never pins the whole gather buffer."""
+        out = []
+        for i, (h, parent) in enumerate(metas):
+            out.append(BlockRecord(
+                hash=h, parent=parent,
+                arrays={name: np.ascontiguousarray(a[:, i])
+                        for name, a in arrays.items()}))
+        return out
+
+
+# --------------------------------------------------------- transfer plumbing
+
+#: The donor replica URL for the CURRENT request, bound by the chain
+#: server from the router's ``X-KV-Transfer-From`` hint. Rides the same
+#: copied-context mechanism as the flight timeline, so ``Engine.submit``
+#: sees it without any chain signature change.
+_TRANSFER_SOURCE: ContextVar[Optional[str]] = ContextVar(
+    "kv_transfer_source", default=None)
+
+
+def bind_transfer_source(url: Optional[str]):
+    return _TRANSFER_SOURCE.set(url)
+
+
+def unbind_transfer_source(token) -> None:
+    _TRANSFER_SOURCE.reset(token)
+
+
+def current_transfer_source() -> Optional[str]:
+    return _TRANSFER_SOURCE.get()
+
+
+def donor_allowed(url: str) -> bool:
+    """Donor trust gate: ``KV_TRANSFER_ALLOW`` (comma-separated URL
+    prefixes) scopes who a replica will fetch pages from. The hint
+    header reaches the replica from the caller, so on a deployment
+    whose replicas are directly reachable this is the SSRF/poisoning
+    boundary — set it to the fleet's replica URL prefixes. Empty
+    (default) trusts the hint like the other internal control headers
+    (X-Deadline-Ms), which is right when only the router can reach the
+    replicas (docs/kv-tiering.md, trust model)."""
+    import os
+    allow = os.environ.get("KV_TRANSFER_ALLOW", "").strip()
+    if not allow:
+        return True
+    for prefix in (p.strip() for p in allow.split(",") if p.strip()):
+        if url == prefix:
+            return True
+        if not url.startswith(prefix):
+            continue
+        # Boundary check: a bare startswith would let an allow entry
+        # `http://replica-1` admit `http://replica-1.attacker.example`.
+        # The char after the prefix must END the authority component —
+        # a path, a port, or the prefix itself already ending there.
+        if prefix.endswith(("/", ":")) or url[len(prefix)] in "/:":
+            return True
+    return False
+
+
+def fetch_blocks(url: str, hashes: Sequence[bytes], *,
+                 timeout_s: float = 5.0, max_pages: int = 32
+                 ) -> Optional[tuple[dict, list[BlockRecord]]]:
+    """Fetch up to ``max_pages`` blocks from a sibling replica's
+    ``GET /control/kv_pages``. Returns ``(meta, records)`` or None on
+    ANY failure — timeout, connection error, bad blob. The whole
+    attempt (fault injection point ``kv.transfer`` included) runs on a
+    bounded worker thread: a hung donor costs the caller exactly
+    ``timeout_s`` and a cold prefill, never a wedged request."""
+    want = list(hashes)[:max(1, int(max_pages))]
+    if not want:
+        return None
+    box: dict = {}
+
+    def work() -> None:
+        try:
+            faults.inject("kv.transfer")
+            import requests
+            resp = requests.get(
+                url.rstrip("/") + "/control/kv_pages",
+                params={"hashes": ",".join(h.hex() for h in want)},
+                timeout=timeout_s)
+            if resp.status_code != 200 or not resp.content:
+                box["result"] = None
+                return
+            box["result"] = from_blob(resp.content)
+        except Exception as exc:  # noqa: BLE001 — fetch is best-effort
+            box["error"] = exc
+
+    t = threading.Thread(target=work, daemon=True,
+                         name="kv-transfer-fetch")
+    t.start()
+    t.join(timeout_s)
+    if "error" in box:
+        logger.debug("kv transfer fetch from %s failed: %s", url,
+                     box["error"])
+        return None
+    if "result" not in box:   # still running: hung donor — place cold
+        logger.warning("kv transfer fetch from %s timed out after %.1fs; "
+                       "placing cold", url, timeout_s)
+        return None
+    return box["result"]

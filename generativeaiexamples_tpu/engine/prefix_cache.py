@@ -37,8 +37,9 @@ engine republishes counters under its own stats lock.
 from __future__ import annotations
 
 import hashlib
+import heapq
 from dataclasses import dataclass, field
-from typing import Optional, Sequence
+from typing import Callable, Optional, Sequence
 
 BlockHash = bytes
 
@@ -125,6 +126,15 @@ class PrefixCache:
     _entries: dict[BlockHash, _Entry] = field(default_factory=dict)
     _pages: dict[int, BlockHash] = field(default_factory=dict)  # reverse map
     _tick: int = 0
+    # Evictable-leaf min-heap of (tick, hash), maintained INCREMENTALLY:
+    # an entry is pushed when it becomes evictable (released to
+    # refcount 0 with no children; or its last child goes) and lazily
+    # invalidated — acquire/insert never touch the heap, a popped entry
+    # is re-checked against the live _Entry (refcount, children, tick)
+    # and skipped when stale. evict() therefore does O(log n) work per
+    # freed page plus O(stale) skips, never an O(entries) rescan per
+    # admission (warm-chat steady state evicts nearly every admission).
+    _heap: list = field(default_factory=list)
     stats: CacheStats = field(default_factory=CacheStats)
 
     def __len__(self) -> int:
@@ -133,6 +143,14 @@ class PrefixCache:
     @property
     def cached_pages(self) -> int:
         return len(self._pages)
+
+    def page_of(self, h: BlockHash) -> Optional[int]:
+        """Physical pool page holding a cached block, or None — the
+        KV-tier export path's read-only probe (cached pages hold pure
+        prompt KV and are immutable while resident, so reading them out
+        is always safe)."""
+        e = self._entries.get(h)
+        return e.page if e is not None else None
 
     def owns(self, page: int) -> bool:
         """Whether this page is cache property (must NOT return to the
@@ -160,6 +178,14 @@ class PrefixCache:
             pages.append(e.page)
         return pages
 
+    def _push_if_evictable(self, h: BlockHash, e: _Entry) -> None:
+        """Heap maintenance: an entry enters the evictable-leaf heap the
+        moment it becomes reclaimable. Duplicate pushes for the same
+        hash (e.g. released, re-acquired, released again) are fine —
+        stale copies carry an old tick and are skipped at pop."""
+        if e.refcount == 0 and e.children == 0:
+            heapq.heappush(self._heap, (e.tick, h))
+
     def release(self, hashes: Sequence[BlockHash]) -> None:
         """Drop one ref per hash (request retire). Refcount-0 entries
         stay resident — reclaimable leaf-first by ``evict`` — with
@@ -171,6 +197,7 @@ class PrefixCache:
             e.tick = self._tick
             if e.refcount < 0:  # pragma: no cover - invariant guard
                 raise AssertionError("prefix cache refcount underflow")
+            self._push_if_evictable(h, e)
 
     def insert(self, h: BlockHash, parent: Optional[BlockHash],
                page: int) -> bool:
@@ -189,7 +216,20 @@ class PrefixCache:
         self.stats.inserted_pages += 1
         return True
 
-    def evict(self, n_pages: int) -> list[int]:
+    def _unlink(self, h: BlockHash, victim: _Entry) -> None:
+        """Remove one evictable entry, keeping chain integrity: the
+        parent's child count drops, and a parent that just became an
+        evictable leaf joins the heap."""
+        if victim.parent is not None:
+            parent = self._entries[victim.parent]
+            parent.children -= 1
+            self._push_if_evictable(victim.parent, parent)
+        del self._entries[h]
+        del self._pages[victim.page]
+
+    def evict(self, n_pages: int,
+              sink: Optional[Callable[[BlockHash, _Entry], None]] = None
+              ) -> list[int]:
         """Reclaim up to ``n_pages`` refcount-0 pages, LRU first and
         leaf-first (a parent only becomes evictable once its children
         are gone, so every resident chain stays walkable root-to-leaf).
@@ -197,27 +237,38 @@ class PrefixCache:
 
         Runs on the serve loop's admission path, and in warm-chat steady
         state (pool full of resident prefixes) nearly EVERY admission
-        evicts — so this is one O(entries) scan per call plus
-        O(log entries) per freed page (a heap of evictable leaves;
-        parents join it as their last child goes), not a rescan per
-        page."""
-        import heapq
+        evicts — so the evictable-leaf heap is maintained INCREMENTALLY
+        across calls (pushed on release-to-zero and last-child-gone,
+        lazily invalidated on acquire/remove): O(log entries) per freed
+        page plus stale-entry skips, never an O(entries) rescan per
+        call (pinned by the no-rescan counting test).
+
+        ``sink`` is called with ``(hash, entry)`` for each victim just
+        BEFORE removal — the engine's KV-tier offload hook (the entry's
+        page content is about to leave HBM)."""
         freed: list[int] = []
-        heap = [(e.tick, h) for h, e in self._entries.items()
-                if e.refcount == 0 and e.children == 0]
-        heapq.heapify(heap)
-        while heap and len(freed) < n_pages:
-            _, h = heapq.heappop(heap)
+        while self._heap and len(freed) < n_pages:
+            tick, h = heapq.heappop(self._heap)
             victim = self._entries.get(h)
-            if victim is None or victim.refcount or victim.children:
-                continue  # stale heap entry (shouldn't occur single-call)
-            if victim.parent is not None:
-                parent = self._entries[victim.parent]
-                parent.children -= 1
-                if parent.refcount == 0 and parent.children == 0:
-                    heapq.heappush(heap, (parent.tick, victim.parent))
-            del self._entries[h]
-            del self._pages[victim.page]
+            if victim is None or victim.refcount or victim.children \
+                    or victim.tick != tick:
+                continue  # stale: re-acquired, re-released, or removed
+            if sink is not None:
+                sink(h, victim)
+            self._unlink(h, victim)
             freed.append(victim.page)
         self.stats.evicted_pages += len(freed)
         return freed
+
+    def remove(self, h: BlockHash) -> Optional[int]:
+        """Explicitly demote one block (session suspend): drop the entry
+        and return its page — but only when it is reclaimable right now
+        (refcount 0, no resident children). Returns None otherwise; the
+        caller walks chains leaf-first so shared interior blocks simply
+        stay resident. Not counted as a pressure eviction. Heap copies
+        of the removed hash go stale and are skipped at pop."""
+        e = self._entries.get(h)
+        if e is None or e.refcount or e.children:
+            return None
+        self._unlink(h, e)
+        return e.page

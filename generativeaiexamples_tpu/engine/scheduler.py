@@ -70,6 +70,14 @@ class StepCostModel:
     # priced 1:1 with prefill tokens (same forward math, the honest
     # default until the artifact carries the measurement).
     verify_ms_per_token: float = 0.0
+    # KV-tier page migration (engine/kv_tier.py): milliseconds to move
+    # one KV page host->device (restore) / device->host (offload).
+    # 0 = unmeasured — the restore-vs-recompute decision then assumes
+    # restore wins (on every real interconnect a page upload is far
+    # cheaper than recomputing a page of prefill) until the online
+    # calibrator has measured actual transfers.
+    h2d_ms_per_page: float = 0.0
+    d2h_ms_per_page: float = 0.0
     slots: int = 8
     source: str = "default"
 
@@ -88,9 +96,13 @@ class StepCostModel:
             # conservative end of the measured 3-8x range).
             prefill = decode / max(1, slots) / 4.0
         verify = profile.get("verify_ms_per_token") or 0.0
+        h2d = profile.get("h2d_ms_per_page") or 0.0
+        d2h = profile.get("d2h_ms_per_page") or 0.0
         return cls(decode_step_ms=decode,
                    prefill_ms_per_token=float(prefill),
                    verify_ms_per_token=float(verify),
+                   h2d_ms_per_page=float(h2d),
+                   d2h_ms_per_page=float(d2h),
                    slots=slots, source=source)
 
     @classmethod
@@ -150,6 +162,25 @@ class StepCostModel:
         return max(1, math.ceil(
             positions * self.verify_ms_per_token
             / self.prefill_ms_per_token))
+
+    def restore_ms(self, pages: int) -> float:
+        """Modeled wall ms to restore ``pages`` KV pages host->device."""
+        return max(0, pages) * self.h2d_ms_per_page
+
+    def restore_cheaper(self, pages: int, page_size: int) -> bool:
+        """The KV-tier admission decision: is restoring ``pages`` pages
+        from host RAM priced cheaper than recomputing their tokens
+        through prefill? Unmeasured H2D (0) answers True — restore is
+        assumed to win until the online calibrator has real transfer
+        measurements; once it does, the comparison is honest per
+        deployment (engine counts the refusals as
+        ``kv_restore_skipped_cost``)."""
+        if pages <= 0:
+            return False
+        if self.h2d_ms_per_page <= 0:
+            return True
+        return self.restore_ms(pages) \
+            < pages * page_size * self.prefill_ms_per_token
 
 
 def derive_round_budget(model: StepCostModel, steps_per_round: int,
@@ -244,6 +275,18 @@ class OnlineCalibrator:
         if positions > 0:
             self._observe("verify_ms_per_token", device_ms / positions)
 
+    def observe_h2d(self, pages: int, wall_ms: float) -> None:
+        """A KV-tier restore uploaded ``pages`` pages host->device
+        (engine-measured dispatch wall — the restore pricing input)."""
+        if pages > 0:
+            self._observe("h2d_ms_per_page", wall_ms / pages)
+
+    def observe_d2h(self, pages: int, wall_ms: float) -> None:
+        """A KV-tier offload read ``pages`` pages back device->host
+        (harvest-measured readback wait)."""
+        if pages > 0:
+            self._observe("d2h_ms_per_page", wall_ms / pages)
+
     def _blend(self, key: str, prior_value: float) -> float:
         ewma = self._ewma.get(key)
         if ewma is None:
@@ -272,6 +315,10 @@ class OnlineCalibrator:
                 verify_ms_per_token=self._blend(
                     "verify_ms_per_token",
                     self.prior.verify_ms_per_token),
+                h2d_ms_per_page=self._blend(
+                    "h2d_ms_per_page", self.prior.h2d_ms_per_page),
+                d2h_ms_per_page=self._blend(
+                    "d2h_ms_per_page", self.prior.d2h_ms_per_page),
                 source=self.prior.source + "+online")
             self._dirty = False
             return self._cached

@@ -161,6 +161,7 @@ class RoundRecord:
         # dispatch (scheduler thread, filled until seal)
         "decode_slots", "spec_drafted", "verify_positions",
         "prefill_tokens", "grants", "pages_touched", "hbm_bytes",
+        "kv_restore_pages",
         "dispatch_ms", "modeled_ms", "t_dispatch_done",
         # execution (harvest thread)
         "harvest_wait_ms", "first_readback_ms", "tokens_emitted",
@@ -188,6 +189,11 @@ class RoundRecord:
         self.grants: list[tuple[str, int]] = []
         self.pages_touched = 0
         self.hbm_bytes = 0
+        # KV-tier H2D traffic: pages restored from host RAM ahead of
+        # this round's chunk grants (engine/kv_tier.py) — their bytes
+        # are folded into hbm_bytes; the count is kept separately so
+        # the round record shows restore work explicitly.
+        self.kv_restore_pages = 0
         self.dispatch_ms = 0.0
         self.modeled_ms = 0.0
         self.t_dispatch_done = self.t_start
@@ -240,6 +246,7 @@ class RoundRecord:
                 "first_tokens": self.first_tokens,
                 "spec_accepted": self.spec_accepted,
                 "pages_touched": self.pages_touched,
+                "kv_restore_pages": self.kv_restore_pages,
                 "hbm_bytes_est": self.hbm_bytes,
                 "bw_util": round(self.bw_util, 4),
                 "drift_ratio": round(self.drift_ratio, 3),

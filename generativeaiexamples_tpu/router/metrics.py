@@ -44,6 +44,13 @@ ROUTER_METRICS: dict[str, tuple[str, tuple[str, ...], str]] = {
         "gauge", (),
         "in-flight streams still running on DRAINING replicas, summed "
         "from heartbeats — a rollout waits for this to reach 0"),
+    "router_kv_transfer_hints_total": (
+        "counter", (),
+        "placements forwarded with an X-KV-Transfer-From donor hint: "
+        "the chosen replica missed the prompt's prefix but a sibling's "
+        "affinity sketch covers it, so the replica fetches the prefix "
+        "pages from the sibling instead of re-prefilling "
+        "(docs/kv-tiering.md)"),
     "router_replica_queue_depth": (
         "gauge", ("replica",),
         "per-replica engine dispatch queue depth from the last "

@@ -133,13 +133,23 @@ class FleetRouter:
                  heartbeat_timeout_s: float = 2.0,
                  retry_attempts: int = 3,
                  connect_timeout_s: float = 5.0,
-                 forward_timeout_s: float = 300.0):
+                 forward_timeout_s: float = 300.0,
+                 kv_transfer: bool = False,
+                 kv_transfer_min_blocks: int = 2):
         self.table = table
         self.heartbeat_s = float(heartbeat_s)
         self.heartbeat_timeout_s = float(heartbeat_timeout_s)
         self.retry_attempts = max(1, int(retry_attempts))
         self.connect_timeout_s = float(connect_timeout_s)
         self.forward_timeout_s = float(forward_timeout_s)
+        # Cross-replica KV-page transfer (docs/kv-tiering.md): on a
+        # placement whose replica misses the prompt's prefix while a
+        # sibling's sketch covers it, forward an X-KV-Transfer-From
+        # donor hint so the replica pulls the pages instead of
+        # re-prefilling. Requires tiering (KV_HOST_POOL_TOKENS>0) on
+        # the replicas; the hint is ignored where tiering is off.
+        self.kv_transfer = bool(kv_transfer)
+        self.kv_transfer_min_blocks = max(1, int(kv_transfer_min_blocks))
         self._session: Optional[aiohttp.ClientSession] = None
         self._hb_task: Optional[asyncio.Task] = None
 
@@ -227,6 +237,18 @@ class FleetRouter:
             if rep is None:
                 break
             tried.append(rep.name)
+            # Fleet-wide cache: a placement miss with a covering sibling
+            # carries a donor hint — recomputed per attempt, since the
+            # donor depends on who was chosen.
+            fwd_headers.pop("X-KV-Transfer-From", None)
+            if self.kv_transfer and blocks:
+                donor = self.table.transfer_donor(
+                    blocks, chosen=rep.name,
+                    min_blocks=self.kv_transfer_min_blocks)
+                if donor is not None:
+                    fwd_headers["X-KV-Transfer-From"] = donor
+                    router_metrics.counter(
+                        "router_kv_transfer_hints_total").inc()
             try:
                 faults.inject("router.forward", tag=rep.name)
                 assert self._session is not None
@@ -411,6 +433,7 @@ def create_router_app(replicas: Sequence[tuple[str, str]] = (), *,
                       policy: Optional[str] = None,
                       heartbeat_s: Optional[float] = None,
                       retry_attempts: Optional[int] = None,
+                      kv_transfer: Optional[bool] = None,
                       run_heartbeat: bool = True) -> web.Application:
     """Build the router app. ``replicas`` is (name, url) pairs; pass a
     pre-built ``table`` instead to control scoring knobs. Env defaults:
@@ -418,7 +441,8 @@ def create_router_app(replicas: Sequence[tuple[str, str]] = (), *,
     ``ROUTER_AFFINITY_BLOCK_BYTES`` / ``ROUTER_AFFINITY_HEAD_BYTES`` /
     ``ROUTER_SKETCH_CAP``, ``ROUTER_BREAKER_FAILURES`` /
     ``ROUTER_BREAKER_COOLDOWN_S``, ``ROUTER_CONNECT_TIMEOUT_S`` /
-    ``ROUTER_FORWARD_TIMEOUT_S`` (docs/router.md)."""
+    ``ROUTER_FORWARD_TIMEOUT_S``, ``ROUTER_KV_TRANSFER`` /
+    ``ROUTER_KV_TRANSFER_MIN_BLOCKS`` (docs/router.md)."""
     if table is None:
         table = ReplicaTable(
             policy=policy or os.environ.get("ROUTER_POLICY", "affinity"),
@@ -439,7 +463,12 @@ def create_router_app(replicas: Sequence[tuple[str, str]] = (), *,
         retry_attempts=(retry_attempts if retry_attempts is not None
                         else int(_env_float("ROUTER_RETRY_ATTEMPTS", 3))),
         connect_timeout_s=_env_float("ROUTER_CONNECT_TIMEOUT_S", 5.0),
-        forward_timeout_s=_env_float("ROUTER_FORWARD_TIMEOUT_S", 300.0))
+        forward_timeout_s=_env_float("ROUTER_FORWARD_TIMEOUT_S", 300.0),
+        kv_transfer=(kv_transfer if kv_transfer is not None
+                     else os.environ.get("ROUTER_KV_TRANSFER", "")
+                     not in ("", "0", "false", "off")),
+        kv_transfer_min_blocks=int(
+            _env_float("ROUTER_KV_TRANSFER_MIN_BLOCKS", 2)))
 
     app = web.Application(client_max_size=100 * 1024 ** 2)
     app[ROUTER] = router

@@ -242,6 +242,33 @@ class ReplicaTable:
             chosen.selections += 1
             return chosen
 
+    def transfer_donor(self, blocks: Sequence[BlockHash], chosen: str,
+                       min_blocks: int = 2) -> Optional[str]:
+        """Cross-replica KV-transfer hint: when the CHOSEN replica's
+        sketch misses this prompt's head but a reachable sibling's
+        covers it (strictly better, and by at least ``min_blocks`` —
+        a one-block match is not worth a network fetch), return the
+        sibling's URL. The chosen replica then pulls the prefix pages
+        from the donor over ``GET /control/kv_pages`` instead of
+        re-prefilling (docs/kv-tiering.md). Draining donors still
+        qualify — their control plane keeps serving while admission is
+        closed, which is exactly the rollout case where the pages would
+        otherwise die with the pod."""
+        with self._lock:
+            me = self._replicas.get(chosen)
+            my_match = self._match(me, blocks) if me is not None else 0
+            best, best_match = None, 0
+            for rep in self._replicas.values():
+                if rep.name == chosen or not rep.reachable:
+                    continue
+                m = self._match(rep, blocks)
+                if m > best_match:
+                    best, best_match = rep, m
+            if best is not None and best_match >= max(1, min_blocks) \
+                    and best_match > my_match:
+                return best.url
+        return None
+
     # ------------------------------------------------------------- health
 
     def update_health(self, name: str, *, ok: bool, ready: bool = True,

@@ -19,6 +19,16 @@ point                   where it fires
                         (router/server.py, per attempt — retries re-fire)
 ``replica.heartbeat``   the router's per-replica heartbeat probe
                         (router/server.py)
+``kv.offload``          the KV tier's eviction-time D2H page offload
+                        (engine/engine.py serve loop; a failure drops
+                        the pages exactly as the untiered engine did)
+``kv.restore``          the KV tier's admission-time H2D page restore
+                        (engine/engine.py; a failure falls back to
+                        recomputing the tokens through prefill)
+``kv.transfer``         the cross-replica prefix-page fetch
+                        (engine/kv_tier.py fetch_blocks, on the
+                        requesting side; a hang is bounded by the
+                        transfer timeout and the request places cold)
 ======================  ====================================================
 
 A **fault plan** maps points to behaviors::
@@ -67,6 +77,7 @@ from .errors import FrameworkError
 POINTS = frozenset({
     "retrieval.search", "embed", "engine.dispatch", "engine.harvest",
     "http.connect", "router.forward", "replica.heartbeat",
+    "kv.offload", "kv.restore", "kv.transfer",
 })
 
 #: Upper bound on a ``hang`` fault, seconds (env-overridable).

@@ -47,7 +47,8 @@ def fleet_section(engines):
     return bench.run_fleet_bench(
         engines, sessions=3, turns=3, session_rps=4.0,
         system_chars=300, user_chars=40, num_tokens=4,
-        slo_ttft_ms=30000.0, seed=3, heartbeat_s=0.3)
+        slo_ttft_ms=30000.0, seed=3, transfer_arm=True,
+        heartbeat_s=0.3)
 
 
 def _synthetic_with(fleet):
@@ -67,14 +68,14 @@ def test_fleet_bench_end_to_end(fleet_section):
     section = fleet_section
     assert section["replicas"] == 2
     assert [p["policy"] for p in section["policies"]] \
-        == ["round_robin", "affinity"]
+        == ["round_robin", "affinity", "affinity_transfer"]
     for p in section["policies"]:
         assert p["offered_turns"] == 9
         assert p["errors"] == 0 and p["completed"] == 9
         assert 0.0 <= p["slo_attainment"] <= 1.0
         assert p["ttft_p50_ms"] and p["ttft_p50_ms"] > 0
         assert sum(p["placed"].values()) == 9
-    rr, aff = section["policies"]
+    rr, aff = section["policies"][:2]
     # the headline the router exists to move: cross-replica prefix reuse
     assert aff["prefix_hit_tokens"] > rr["prefix_hit_tokens"]
     assert aff["prefix_hit_rate"] >= rr["prefix_hit_rate"]
@@ -83,6 +84,14 @@ def test_fleet_bench_end_to_end(fleet_section):
     # round-robin really alternated replicas (the baseline is honest):
     # 9 placements strictly alternate into a 5/4 split
     assert sorted(rr["placed"].values()) == [4, 5]
+    # the transfer arm ran with donor hints enabled; these replicas
+    # have no host KV tier, so the hint is inert and no pages move
+    # (real page movement over /control/kv_pages is pinned by
+    # tests/test_kv_tier.py::test_cross_replica_transfer_end_to_end)
+    transfer = section["policies"][2]
+    assert transfer["kv_transfer"] is True
+    assert transfer["kv_transfer_pages"] == 0
+    assert not rr["kv_transfer"] and not aff["kv_transfer"]
 
 
 def test_fleet_section_schema_valid(fleet_section):

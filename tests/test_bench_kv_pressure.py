@@ -1,0 +1,90 @@
+"""Tier-1 CPU smoke of the KV-pressure bench scenario: multi-turn chat
+with a working set N× the device KV pool, tiering off vs on, over a
+real tiny engine — plus the schema contract for the new ``kv_pressure``
+section (warm TTFT + restore hit rate per arm)."""
+
+import copy
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import bench
+from generativeaiexamples_tpu.models import llama
+from generativeaiexamples_tpu.models.configs import LlamaConfig
+from generativeaiexamples_tpu.models.tokenizer import ByteTokenizer
+from tools.check_bench_schema import (BenchSchemaError, load_schema,
+                                      validate_result)
+
+CFG = LlamaConfig(vocab_size=259 + 5, hidden_size=64, intermediate_size=128,
+                  num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+                  max_position_embeddings=256)
+
+
+@pytest.fixture(scope="module")
+def section():
+    params = llama.init_params(CFG, jax.random.key(17), dtype=jnp.float32)
+    return bench.run_kv_pressure_bench(
+        params, CFG, ByteTokenizer(),
+        ratios=(1, 2), pool_tokens=96, host_pool_tokens=2048,
+        turns=2, user_len=16, reply_len=4, seed=5,
+        page_size=16, prefill_buckets=(32, 64), dtype="float32",
+        steps_per_round=4)
+
+
+def _synthetic_with(kvp):
+    pipeline = bench.pipeline_snapshot({})
+    return bench.assemble_result(
+        kind="engine", model="llama-tiny", headline=10.0,
+        engine_p50=8.0, engine_p99=12.0, tput=100.0,
+        achieved_bw=1e9, bw_util=0.1, bw_steady=True,
+        chat=None, e2e_p50=None, e2e_dist=None, e2e_breakdown=None,
+        e2e_tps_p50=None, pipeline=pipeline, quant="none", kv_quant=None,
+        weights="random-init", prompt_len=16, out_len=4, slots=2,
+        steps_per_round=4, kv_pool_pages=8, device="cpu", rtt_ms=None,
+        n_devices=1, bench_seconds=1.0, kv_pressure=kvp)
+
+
+def test_kv_pressure_scenario_end_to_end(section):
+    assert section["pool_tokens"] == 96
+    assert section["ratios"] == [1, 2]
+    # (off, on) per ratio, in ratio order
+    assert [(a["ratio"], a["tiering"]) for a in section["arms"]] \
+        == [(1, False), (1, True), (2, False), (2, True)]
+    for arm in section["arms"]:
+        assert arm["sessions"] >= 2
+        assert arm["cold_p50_ttft_ms"] and arm["cold_p50_ttft_ms"] > 0
+        assert arm["warm_p50_ttft_ms"] and arm["warm_p50_ttft_ms"] > 0
+        if not arm["tiering"]:
+            # off arms have no tier at all: no offload, no restore
+            assert arm["kv_tier_offload_pages"] == 0
+            assert arm["kv_tier_restore_pages"] == 0
+            assert arm["kv_restore_hit_rate"] == 0.0
+    on2 = next(a for a in section["arms"]
+               if a["tiering"] and a["ratio"] == 2)
+    # the pressure arm actually exercised the tier: pages left HBM and
+    # came back at admission
+    assert on2["kv_tier_offload_pages"] > 0
+    assert on2["kv_tier_restore_pages"] > 0
+    assert on2["kv_restore_hit_rate"] > 0
+
+
+def test_kv_pressure_section_schema_valid(section):
+    validate_result(_synthetic_with(section))
+    validate_result(_synthetic_with(None))   # pressure-less runs pass
+
+
+def test_kv_pressure_section_matches_schema_keys(section):
+    schema = load_schema()
+    assert set(section) == set(schema["kv_pressure"])
+    for arm in section["arms"]:
+        assert set(arm) == set(schema["kv_pressure_arm"])
+
+
+def test_kv_pressure_arm_rename_fails_fast(section):
+    doctored = copy.deepcopy(section)
+    doctored["arms"][0]["restore_rate"] = \
+        doctored["arms"][0].pop("kv_restore_hit_rate")
+    with pytest.raises(BenchSchemaError, match="kv_pressure.arms"):
+        validate_result(_synthetic_with(doctored))

@@ -1,0 +1,56 @@
+"""tools/preflight.py: the consolidated contract gate — every check
+green on a clean tree, and each check actually detects its failure
+class (a preflight that can't fail protects nothing)."""
+
+import json
+
+import pytest
+
+from tools import preflight
+
+
+def test_all_checks_green():
+    results = preflight.run_checks()
+    assert set(results) == set(preflight.CHECKS)
+    for name, errors in results.items():
+        assert errors == [], f"{name}: {errors}"
+
+
+def test_cli_exit_codes(capsys):
+    assert preflight.main([]) == 0
+    out = capsys.readouterr().out
+    for name in preflight.CHECKS:
+        assert f"ok   {name}" in out
+    assert preflight.main(["--list"]) == 0
+
+
+def test_cli_subset():
+    assert preflight.main(["metrics-docs"]) == 0
+
+
+def test_perf_gate_detects_regression(tmp_path):
+    base = tmp_path / "base.json"
+    cand = tmp_path / "cand.json"
+    base.write_text(json.dumps({"decode_tokens_per_sec": 500.0,
+                                "engine_p50_ttft_ms": 100.0}))
+    cand.write_text(json.dumps({"decode_tokens_per_sec": 300.0,
+                                "engine_p50_ttft_ms": 100.0}))
+    errors = preflight.check_perf_gates(
+        pairs=[(str(base), str(cand), {})])
+    assert any("decode_tokens_per_sec" in e for e in errors)
+    # missing artifacts are a loud failure, not a silent pass
+    errors = preflight.check_perf_gates(
+        pairs=[(str(tmp_path / "nope.json"), str(cand), {})])
+    assert errors and "missing" in errors[0]
+
+
+def test_metrics_docs_check_is_the_real_one(monkeypatch):
+    """preflight's metrics-docs check is the same two-way checker the
+    dedicated tier-1 test runs — doctor the doc text and it must
+    fail."""
+    from tools import check_metrics_docs as cmd
+    with open(cmd.DOC_PATH) as f:
+        text = f.read()
+    broken = text.replace("`engine_requests`", "`engine_requestz`")
+    errors = cmd.check(broken)
+    assert any("engine_requests" in e for e in errors)

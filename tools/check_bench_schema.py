@@ -76,7 +76,8 @@ def validate_result(result: dict, schema: dict | None = None) -> None:
     errors: list[str] = []
     _check_types("result", result, schema["top_level"], errors)
     for section in ("engine_pipeline", "engine_rounds", "e2e_ttft_dist_ms",
-                    "chat", "openloop", "fleet", "capacity"):
+                    "chat", "openloop", "fleet", "capacity",
+                    "kv_pressure"):
         sub = result.get(section)
         if isinstance(sub, dict):
             _check_types(section, sub, schema[section], errors)
@@ -131,6 +132,21 @@ def validate_result(result: dict, schema: dict | None = None) -> None:
                 else:
                     errors.append(
                         f"capacity.rungs[{i}]: {entry!r} is not an object")
+    # KV-pressure scenario: each tiering-on/off arm carries the warm-TTFT
+    # / restore-hit headline fields — validated element-wise so a rename
+    # in one arm's dict can't hide behind the list type.
+    kvp = result.get("kv_pressure")
+    if isinstance(kvp, dict):
+        arms = kvp.get("arms")
+        if isinstance(arms, list):
+            for i, entry in enumerate(arms):
+                if isinstance(entry, dict):
+                    _check_types(f"kv_pressure.arms[{i}]", entry,
+                                 schema["kv_pressure_arm"], errors)
+                else:
+                    errors.append(
+                        f"kv_pressure.arms[{i}]: {entry!r} is not an "
+                        f"object")
     breakdown = result.get("e2e_breakdown_ms")
     if isinstance(breakdown, dict):
         allowed = set(schema["breakdown_stages"])

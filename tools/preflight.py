@@ -1,0 +1,219 @@
+"""One-stop repo preflight: every committed-artifact and docs-fence
+contract in one obvious place.
+
+The repo grew four separate guards — ``tools/check_bench_schema.py``
+(the bench output contract), ``tools/check_metrics_docs.py`` (the three
+doc-fenced metric tables), ``obs.metrics.lint_prometheus`` (the
+/metrics exposition rules), and ``tools/perf_diff.py`` (headline
+regression gates over the committed ``BENCH_rNN`` artifacts). Each has
+its own CLI and its own tier-1 test, which means a PR that regresses a
+committed headline artifact or desyncs a docs fence fails in whichever
+corner happens to notice. This module runs ALL of them:
+
+    python tools/preflight.py            # everything; non-zero on any failure
+    python tools/preflight.py --list     # enumerate the checks
+
+Checks:
+
+- **bench-schema** — a fully-assembled synthetic bench result (built
+  through ``bench.assemble_result``, including the KV-pressure and
+  fleet sections) validates against ``tools/bench_schema.json``. The
+  committed round artifacts predate newer required sections and are
+  deliberately NOT schema-checked; their contract is the perf gate
+  below.
+- **metrics-docs** — the engine-gauge / router / round-telemetry
+  tables in ``docs/observability.md`` match the code surfaces two-way.
+- **metrics-lint** — every declared metric surface renders a clean
+  Prometheus exposition (HELP lines, family matching, ``_total``
+  counters).
+- **perf-gates** — ``tools/perf_diff.py`` over committed artifact
+  pairs: each later round must not regress the earlier one's headline
+  metrics (the same pairs/thresholds the tier-1 perf_diff test pins).
+
+Tier-1: ``tests/test_preflight.py`` runs ``run_checks`` green, so a
+fence desync or artifact regression fails the suite through this one
+entry point too.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Callable
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: Committed artifact pairs the perf gate enforces, with per-metric
+#: threshold overrides (p99 tail percentiles over single-digit samples
+#: jitter between runs — same widening the tier-1 perf_diff test uses).
+PERF_GATE_PAIRS: list[tuple[str, str, dict[str, float]]] = [
+    ("BENCH_r04.json", "BENCH_r05.json", {"engine_p99_ttft_ms": 20.0}),
+    ("BENCH_r01.json", "BENCH_r05.json", {"engine_p99_ttft_ms": 20.0}),
+]
+
+
+def check_bench_schema() -> list[str]:
+    """Validate a fully-populated synthetic result through the real
+    emit path (``bench.assemble_result`` -> ``validate_result``)."""
+    sys.path.insert(0, REPO)
+    import bench
+    from tools.check_bench_schema import BenchSchemaError, validate_result
+
+    kv_pressure = {
+        "pool_tokens": 2048, "host_pool_tokens": 8192,
+        "ratios": [1, 2], "turns": 3,
+        "arms": [
+            {"ratio": 1, "tiering": False, "sessions": 2,
+             "cold_p50_ttft_ms": 50.0, "warm_p50_ttft_ms": 40.0,
+             "kv_restore_hit_rate": 0.0, "kv_tier_offload_pages": 0,
+             "kv_tier_restore_pages": 0, "kv_restore_skipped_cost": 0,
+             "prefix_hit_rate": 0.1},
+            {"ratio": 1, "tiering": True, "sessions": 2,
+             "cold_p50_ttft_ms": 50.0, "warm_p50_ttft_ms": 20.0,
+             "kv_restore_hit_rate": 0.5, "kv_tier_offload_pages": 8,
+             "kv_tier_restore_pages": 6, "kv_restore_skipped_cost": 1,
+             "prefix_hit_rate": 0.6},
+        ],
+    }
+    fleet = {
+        "replicas": 2, "sessions": 3, "turns_per_session": 3,
+        "session_rps": 4.0, "slo_ttft_ms": 2000.0, "num_tokens": 4,
+        "policies": [
+            {"policy": p, "offered_turns": 9, "completed": 9,
+             "errors": 0, "slo_attainment": 1.0, "ttft_p50_ms": 10.0,
+             "ttft_p99_ms": 12.0, "cold_ttft_p50_ms": 11.0,
+             "warm_ttft_p50_ms": 9.0, "prefix_hit_tokens": 100,
+             "prefix_hit_rate": 0.5, "placed": {"r0": 5, "r1": 4},
+             "affinity_hit_placements": 3, "retries_connect": 0,
+             "kv_transfer": p == "affinity_transfer",
+             "kv_transfer_pages": 4 if p == "affinity_transfer" else 0}
+            for p in ("round_robin", "affinity", "affinity_transfer")],
+    }
+    result = bench.assemble_result(
+        kind="engine", model="preflight", headline=10.0,
+        engine_p50=8.0, engine_p99=12.0, tput=100.0,
+        achieved_bw=1e9, bw_util=0.1, bw_steady=True,
+        chat=None, e2e_p50=None, e2e_dist=None, e2e_breakdown=None,
+        e2e_tps_p50=None, pipeline=bench.pipeline_snapshot({}),
+        quant="none", kv_quant=None, weights="random-init",
+        prompt_len=16, out_len=4, slots=2, steps_per_round=4,
+        kv_pool_pages=8, device="cpu", rtt_ms=None, n_devices=1,
+        bench_seconds=1.0, fleet=fleet, kv_pressure=kv_pressure)
+    try:
+        validate_result(result)
+    except BenchSchemaError as exc:
+        return [str(exc)]
+    return []
+
+
+def check_metrics_docs() -> list[str]:
+    sys.path.insert(0, REPO)
+    from tools.check_metrics_docs import check
+    return check()
+
+
+def check_metrics_lint() -> list[str]:
+    """Render every declared metric surface into a fresh registry via
+    the same helpers production uses, then lint the exposition."""
+    sys.path.insert(0, REPO)
+    from generativeaiexamples_tpu.engine.engine import _STATS_TEMPLATE
+    from generativeaiexamples_tpu.obs import metrics as obs_metrics
+    from generativeaiexamples_tpu.obs.rounds import (ROUND_METRICS,
+                                                     ROUND_TOKEN_BUCKETS)
+    from generativeaiexamples_tpu.router.metrics import ROUTER_METRICS
+
+    reg = obs_metrics.Registry()
+    stats = dict(_STATS_TEMPLATE)
+    stats["harvest_rounds"] = 1
+    stats["harvest_wait_ms"] = 1.0
+    obs_metrics.record_engine_stats(stats, registry=reg)
+    obs_metrics.observe_stage("engine_ttft", 0.1, registry=reg)
+    timer = obs_metrics.RequestTimer("chain_generate", registry=reg)
+    timer.token(2)
+    timer.finish()
+    for name, (kind, help_txt) in ROUND_METRICS.items():
+        if kind == "counter":
+            reg.counter(name, help_txt).inc()
+        elif kind == "gauge":
+            reg.gauge(name, help_txt).set(1.0)
+        else:
+            buckets = (ROUND_TOKEN_BUCKETS
+                       if name == "engine_round_tokens"
+                       else obs_metrics.STAGE_BUCKETS)
+            reg.histogram(name, help_txt, buckets=buckets).observe(1.0)
+    for name, (kind, labels, help_txt) in ROUTER_METRICS.items():
+        m = (reg.counter if kind == "counter" else reg.gauge)(
+            name, help_txt, labelnames=labels)
+        leaf = m.labels(*(["r0"] * len(labels))) if labels else m
+        leaf.inc() if kind == "counter" else leaf.set(1.0)
+    reg.counter("shed_total", "requests rejected at admission, by reason",
+                labelnames=("reason",)).labels("queue_full").inc()
+    reg.gauge("breaker_state",
+              "circuit breaker state (0 closed, 1 half-open, 2 open)",
+              labelnames=("name",)).labels("retrieval").set(0)
+    return obs_metrics.lint_prometheus(reg.render_prometheus())
+
+
+def check_perf_gates(pairs=None) -> list[str]:
+    sys.path.insert(0, REPO)
+    from tools.perf_diff import diff_files
+    errors: list[str] = []
+    for base, cand, thresholds in (pairs or PERF_GATE_PAIRS):
+        base_p = base if os.path.isabs(base) else os.path.join(REPO, base)
+        cand_p = cand if os.path.isabs(cand) else os.path.join(REPO, cand)
+        if not (os.path.exists(base_p) and os.path.exists(cand_p)):
+            errors.append(f"{base} -> {cand}: artifact missing")
+            continue
+        try:
+            regressions, _ = diff_files(base_p, cand_p,
+                                        per_metric_pct=dict(thresholds))
+        except (OSError, ValueError) as exc:
+            errors.append(f"{base} -> {cand}: {exc}")
+            continue
+        errors.extend(f"{base} -> {cand}: {r}" for r in regressions)
+    return errors
+
+
+CHECKS: dict[str, Callable[[], list[str]]] = {
+    "bench-schema": check_bench_schema,
+    "metrics-docs": check_metrics_docs,
+    "metrics-lint": check_metrics_lint,
+    "perf-gates": check_perf_gates,
+}
+
+
+def run_checks(names=None) -> dict[str, list[str]]:
+    """Run the named checks (default: all). Returns
+    ``{check: [errors]}`` — all-empty values mean a clean tree."""
+    return {name: CHECKS[name]() for name in (names or CHECKS)}
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Run every repo contract check; non-zero exit on "
+                    "any failure.")
+    parser.add_argument("checks", nargs="*", choices=[[], *CHECKS],
+                        help="subset of checks (default: all)")
+    parser.add_argument("--list", action="store_true",
+                        help="list available checks and exit")
+    args = parser.parse_args(argv)
+    if args.list:
+        for name in CHECKS:
+            print(name)
+        return 0
+    failed = 0
+    for name, errors in run_checks(args.checks or None).items():
+        if errors:
+            failed += 1
+            print(f"FAIL {name}:")
+            for e in errors:
+                print(f"  - {e}")
+        else:
+            print(f"ok   {name}")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, REPO)
+    sys.exit(main(sys.argv[1:]))
