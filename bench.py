@@ -789,6 +789,38 @@ def run_fleet_bench(engines, *, sessions=6, turns=4, session_rps=2.0,
             th.start()
         for th in threads:
             th.join(timeout=600)
+        # Fleet-observability block (docs/observability.md): the per-
+        # replica SLO attainment + capacity headroom the router's
+        # /debug/fleet spine computed over THIS arm's traffic —
+        # schema-validated before it lands in the artifact, so a
+        # contract drift fails the bench, not the dashboard.
+        fleet_obs = None
+        try:
+            from generativeaiexamples_tpu.router import fleet as _rfleet
+            snap = requests.get(f"{router_url}/debug/fleet",
+                                timeout=30).json()
+            errs = _rfleet.validate_fleet_snapshot(snap)
+            if errs:
+                raise ValueError("; ".join(errs))
+            fleet_obs = {
+                "slo_attainment": snap["fleet"]["slo_attainment"],
+                "window_requests": snap["fleet"]["window_requests"],
+                "ttft_p50_ms": snap["fleet"]["ttft_p50_ms"],
+                "error_rate": snap["fleet"]["error_rate"],
+                "headroom_tokens_per_sec":
+                    snap["fleet"]["headroom_tokens_per_sec"],
+                "capacity_tokens_per_sec":
+                    snap["fleet"]["capacity_tokens_per_sec"],
+                "replicas": [
+                    {"name": row["name"],
+                     "slo_attainment": row["slo"]["attainment"],
+                     "window_requests": row["slo"]["requests"],
+                     "headroom_tokens_per_sec":
+                         row["headroom_tokens_per_sec"]}
+                    for row in snap["replicas"]],
+            }
+        except Exception as exc:  # noqa: BLE001 — observability block
+            sys.stderr.write(f"bench: fleet_obs capture failed: {exc}\n")
         stop_router()
 
         snap1 = obs_metrics.REGISTRY.snapshot()
@@ -842,12 +874,13 @@ def run_fleet_bench(engines, *, sessions=6, turns=4, session_rps=2.0,
                 'router_retries_total{reason="connect"}')),
             "kv_transfer": bool(kv_transfer),
             "kv_transfer_pages": int(transfer_pages),
-        }
+        }, fleet_obs
 
     arms = [(policy, False, policy) for policy in policies]
     if transfer_arm:
         arms.append(("affinity", True, "affinity_transfer"))
     replica_urls, stop_replicas = serve_apps(apps)
+    fleet_obs = None
     try:
         policy_rows = []
         for policy, kv_transfer, label in arms:
@@ -860,9 +893,13 @@ def run_fleet_bench(engines, *, sessions=6, turns=4, session_rps=2.0,
                     eng.reset()
                 except Exception:  # noqa: BLE001 — comparability only
                     pass
-            policy_rows.append(one_policy(policy, replica_urls,
-                                          kv_transfer=kv_transfer,
-                                          label=label))
+            row, obs = one_policy(policy, replica_urls,
+                                  kv_transfer=kv_transfer, label=label)
+            policy_rows.append(row)
+            # Keep the LAST arm's snapshot (each arm runs its own
+            # router; later arms see the same fleet under the most
+            # production-like policy).
+            fleet_obs = obs if obs is not None else fleet_obs
     finally:
         stop_replicas()
     return {
@@ -873,6 +910,7 @@ def run_fleet_bench(engines, *, sessions=6, turns=4, session_rps=2.0,
         "slo_ttft_ms": float(slo_ttft_ms),
         "num_tokens": int(num_tokens),
         "policies": policy_rows,
+        "fleet_obs": fleet_obs,
     }
 
 

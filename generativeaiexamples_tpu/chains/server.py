@@ -43,7 +43,11 @@ the probe must say so instead of letting the router/k8s keep routing to
 it. The health body doubles as the router's heartbeat payload: a
 ``load`` block with the edge's in-flight stream count and the engine's
 reject/deadline-drop counters (per-app state only — safe for N
-in-process replicas sharing one metrics registry).
+in-process replicas sharing one metrics registry), plus — for the
+router's ``GET /debug/fleet`` spine — ``rounds`` (round-telemetry
+rolling aggregates incl. the wall-clock token rate), ``capacity`` (the
+calibrated step-cost model's decode ceiling), and ``kv_tier``
+(host-tier residency) blocks.
 """
 
 from __future__ import annotations
@@ -55,6 +59,7 @@ import json
 import math
 import os
 import threading
+import time
 from typing import Optional
 
 from aiohttp import web
@@ -209,6 +214,83 @@ def create_app(example: BaseExample,
                 logger.debug("engine stats unavailable", exc_info=True)
         return load
 
+    def _obs_blocks() -> dict:
+        """Fleet-observability blocks riding the heartbeat body (PR 12):
+        round-telemetry rolling aggregates (plus the wall-clock token
+        rate the router's headroom estimate subtracts), the modeled
+        decode capacity from the live (calibrated) step-cost model, and
+        the KV-tier residency counters. Everything here feeds
+        ``GET /debug/fleet`` on the router — the ``load`` block above
+        stays the placement-scoring contract and is untouched. Absent
+        engine → absent blocks; failures degrade to absence (a health
+        answer must never 500 over telemetry)."""
+        out: dict = {}
+        engine = getattr(getattr(example, "llm", None), "engine", None)
+        if engine is None:
+            return out
+        # Each block degrades to absence INDEPENDENTLY: a rounds-ring
+        # hiccup must not cost the heartbeat its capacity block (the
+        # router would then drop this replica from fleet headroom over
+        # an unrelated failure).
+        try:
+            agg = engine.rounds.snapshot(
+                limit=0, engine_tag=engine.engine_tag)["aggregates"]
+            if agg.get("rounds_completed"):
+                # Observed decode load: tokens over the aggregation
+                # window's WALL span (the ring-relative tokens_per_sec
+                # is a device-busy rate — near capacity whenever busy —
+                # so it cannot measure utilization; the wall rate can).
+                span_s = max(1e-3, time.time()
+                             - agg["window_start_unix_ms"] / 1e3)
+                out["rounds"] = {
+                    "rounds_completed": int(agg["rounds_completed"]),
+                    "tokens_per_sec": float(agg.get("tokens_per_sec", 0.0)),
+                    "wall_tokens_per_sec": round(
+                        agg.get("tokens_emitted", 0) / span_s, 2),
+                    "avg_device_ms": float(agg.get("avg_device_ms", 0.0)),
+                    "avg_bw_util": float(agg.get("avg_bw_util", 0.0)),
+                    "avg_drift_ratio": float(
+                        agg.get("avg_drift_ratio", 0.0)),
+                    "interleaved_share": float(
+                        agg.get("interleaved_share", 0.0)),
+                }
+        except Exception:  # noqa: BLE001 — health must never 500
+            logger.debug("rounds block unavailable", exc_info=True)
+        try:
+            sched = getattr(engine, "_sched", None)
+            if sched is not None:
+                # Modeled decode ceiling from the SAME step-cost model
+                # the scheduler budgets and the open-loop bench fits:
+                # at full occupancy one decode step emits one token per
+                # slot, so capacity = slots / step seconds. The online
+                # calibrator keeps decode_step_ms honest per deployment.
+                cost = sched.cost
+                step_ms = max(1e-6, float(cost.decode_step_ms))
+                out["capacity"] = {
+                    "slots": int(engine.cfg.max_slots),
+                    "decode_step_ms": round(step_ms, 4),
+                    "model_source": str(cost.source),
+                    "capacity_tokens_per_sec": round(
+                        engine.cfg.max_slots * 1e3 / step_ms, 1),
+                }
+        except Exception:  # noqa: BLE001
+            logger.debug("capacity block unavailable", exc_info=True)
+        try:
+            if getattr(engine, "_kv_tier", None) is not None:
+                stats = engine.stats
+                out["kv_tier"] = {
+                    "host_pages": int(stats.get("kv_tier_host_pages", 0)),
+                    "offload_pages": int(
+                        stats.get("kv_tier_offload_pages", 0)),
+                    "restore_pages": int(
+                        stats.get("kv_tier_restore_pages", 0)),
+                    "transfer_pages": int(
+                        stats.get("kv_tier_transfer_pages", 0)),
+                }
+        except Exception:  # noqa: BLE001
+            logger.debug("kv_tier block unavailable", exc_info=True)
+        return out
+
     async def health(request: web.Request) -> web.Response:
         # Readiness is TRUTHFUL: draining or a tripped generate breaker
         # means every /generate would be rejected, so k8s and the fleet
@@ -222,7 +304,8 @@ def create_app(example: BaseExample,
             status, code = "ok", 200
         return web.json_response(
             {"status": status, "draining": drain.draining,
-             "breaker": breaker.state, "load": _load_block()},
+             "breaker": breaker.state, "load": _load_block(),
+             **_obs_blocks()},
             status=code)
 
     async def control_drain(request: web.Request) -> web.Response:

@@ -69,12 +69,65 @@ ROUTER_METRICS: dict[str, tuple[str, tuple[str, ...], str]] = {
         "gauge", ("replica",),
         "per-replica engine prefix-cache hit rate from the last "
         "heartbeat — fleet-wide cache health at a glance"),
+    "router_heartbeat_failures_total": (
+        "counter", ("replica",),
+        "heartbeat probes that failed to get any HTTP answer from each "
+        "replica — a climbing counter is the poller seeing a partition "
+        "or a dead pod BEFORE placements go wrong"),
+    "router_heartbeat_age_seconds": (
+        "gauge", ("replica",),
+        "seconds since each replica's last heartbeat observation, "
+        "refreshed at scrape time and by the fleet refresh — a value "
+        "far above ROUTER_HEARTBEAT_S means the poller itself has "
+        "stalled, which silent breaker flips would otherwise hide"),
+    "router_requests_total": (
+        "counter", ("outcome",),
+        "router-observed request outcomes: ok (stream completed), shed "
+        "(backpressure relayed/originated), error (5xx/post-connect), "
+        "connect_fail (one connect attempt failed; per attempt), "
+        "midstream_loss (replica died on a 200), disconnect (caller "
+        "hung up)"),
+    "router_ttft_seconds": (
+        "histogram", (),
+        "router-observed time to first upstream body byte per routed "
+        "request — the fleet-edge TTFT distribution, measured at the "
+        "router, not replica self-reports"),
+    "router_slo_attainment": (
+        "gauge", ("replica",),
+        "per-replica SLO attainment over the rolling ROUTER_SLO_WINDOW_S "
+        "outcome window: requests that completed ok within their "
+        "X-Deadline-Ms (or beat ROUTER_SLO_TTFT_MS when no deadline) "
+        "over all router-observed outcomes placed there"),
+    "router_window_shed_rate": (
+        "gauge", ("replica",),
+        "windowed fraction of each replica's router-observed outcomes "
+        "that were backpressure sheds (429/503 relays)"),
+    "router_window_error_rate": (
+        "gauge", ("replica",),
+        "windowed fraction of each replica's router-observed outcomes "
+        "that were errors or failed connect attempts (caller "
+        "disconnects excluded — they say nothing about the replica)"),
+    "router_window_midstream_loss_rate": (
+        "gauge", ("replica",),
+        "windowed fraction of each replica's router-observed outcomes "
+        "that were mid-stream losses (error frame appended to a 200)"),
+    "router_fleet_headroom_tokens_per_sec": (
+        "gauge", (),
+        "fleet capacity-headroom estimate from the last fleet refresh: "
+        "summed modeled decode capacity (per-replica step-cost model "
+        "from the heartbeat) minus observed round-telemetry throughput "
+        "— the number an SLO-driven autoscaler scales on "
+        "(GET /debug/fleet carries the per-replica breakdown)"),
 }
 
 
 def _get(name: str):
     kind, labelnames, help_txt = ROUTER_METRICS[name]
     reg = obs_metrics.REGISTRY
+    if kind == "histogram":
+        return reg.histogram(name, help_txt,
+                             buckets=obs_metrics.STAGE_BUCKETS,
+                             labelnames=labelnames)
     factory = reg.counter if kind == "counter" else reg.gauge
     return factory(name, help_txt, labelnames=labelnames)
 
@@ -85,6 +138,11 @@ def counter(name: str, *labels: str):
 
 
 def gauge(name: str, *labels: str):
+    m = _get(name)
+    return m.labels(*labels) if labels else m
+
+
+def histogram(name: str, *labels: str):
     m = _get(name)
     return m.labels(*labels) if labels else m
 

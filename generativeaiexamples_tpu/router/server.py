@@ -37,10 +37,22 @@ Failure semantics (the part routers get wrong):
 
 A background **heartbeat** polls each replica's ``GET /health`` every
 ``ROUTER_HEARTBEAT_S``: the chain server's truthful readiness body
-(drain state, breaker state, and the ``load`` block) is the router's
+(drain state, breaker state, the ``load`` block, and — since PR 12 —
+the round-telemetry / KV-tier / capacity blocks) is the router's
 entire fleet view — no engine or metrics-scrape coupling. Fault points
 ``router.forward`` / ``replica.heartbeat`` (tag = replica name) let
 chaos plans fail or partition individual replicas (docs/robustness.md).
+
+**Fleet observability spine** (PR 12, docs/observability.md): every
+routed request gets a flight timeline (``router/flight.py`` — the
+placement decision with scored candidates, each connect/retry attempt,
+the first upstream byte as router-observed TTFT, stream end or
+mid-stream loss) behind ``GET /debug/requests``, joinable to the
+replica/engine records by the forwarded ``X-Request-ID``; outcomes feed
+a rolling per-replica SLO window; and ``GET /debug/fleet``
+(``router/fleet.py``) folds heartbeat state, round aggregates, KV-tier
+counters, the SLO window, and a step-cost-model capacity-headroom
+estimate into the one snapshot an autoscaler or operator reads.
 """
 
 from __future__ import annotations
@@ -48,6 +60,7 @@ from __future__ import annotations
 import asyncio
 import json
 import os
+import time
 from typing import Optional, Sequence
 
 import aiohttp
@@ -56,7 +69,9 @@ from aiohttp import web
 from ..obs import flight as obs_flight
 from ..utils import faults
 from ..utils.logging import get_logger
+from . import fleet as router_fleet
 from . import metrics as router_metrics
+from .flight import RouterFlightRecorder
 from .table import ReplicaTable
 
 logger = get_logger(__name__)
@@ -135,8 +150,13 @@ class FleetRouter:
                  connect_timeout_s: float = 5.0,
                  forward_timeout_s: float = 300.0,
                  kv_transfer: bool = False,
-                 kv_transfer_min_blocks: int = 2):
+                 kv_transfer_min_blocks: int = 2,
+                 flight: Optional[RouterFlightRecorder] = None):
         self.table = table
+        # Router flight recorder + rolling SLO window (router/flight.py):
+        # per-router instance, so the fleet bench's per-arm routers and
+        # parallel test routers never interleave timelines or windows.
+        self.flight = flight or RouterFlightRecorder()
         self.heartbeat_s = float(heartbeat_s)
         self.heartbeat_timeout_s = float(heartbeat_timeout_s)
         self.retry_attempts = max(1, int(retry_attempts))
@@ -152,6 +172,7 @@ class FleetRouter:
         self.kv_transfer_min_blocks = max(1, int(kv_transfer_min_blocks))
         self._session: Optional[aiohttp.ClientSession] = None
         self._hb_task: Optional[asyncio.Task] = None
+        self._fleet: Optional[dict] = None   # last refresh_fleet() result
 
     # ---------------------------------------------------------- lifecycle
 
@@ -179,6 +200,11 @@ class FleetRouter:
         while True:
             try:
                 await self.heartbeat_once()
+                # Background fleet aggregation: fold the fresh heartbeat
+                # state + SLO window into the cached snapshot and push
+                # the window/headroom gauges — /metrics stays live even
+                # when nobody reads /debug/fleet.
+                self.refresh_fleet()
             except asyncio.CancelledError:
                 raise
             except Exception:  # noqa: BLE001 — the loop must survive
@@ -212,6 +238,23 @@ class FleetRouter:
             logger.debug("heartbeat to %s failed: %s", rep.name, exc)
             self.table.update_health(rep.name, ok=False, ready=False)
 
+    # -------------------------------------------------------------- fleet
+
+    def refresh_fleet(self) -> dict:
+        """Build the fleet snapshot (``GET /debug/fleet``) from the
+        table's heartbeat-carried state + the SLO window, and publish
+        the derived gauges. Pure local fold — cheap enough to also run
+        on demand for the endpoint, so the view is never staler than
+        the last heartbeat."""
+        self.flight.slo.publish(
+            [r.name for r in self.table.replicas()])
+        self.table.publish_heartbeat_ages()
+        snap = router_fleet.build_fleet_snapshot(
+            self.table, self.flight.slo, heartbeat_s=self.heartbeat_s)
+        router_fleet.publish_fleet_gauges(snap)
+        self._fleet = snap
+        return snap
+
     # ------------------------------------------------------------ forward
 
     async def forward(self, request: web.Request) -> web.StreamResponse:
@@ -223,7 +266,27 @@ class FleetRouter:
         blocks = self.table.affinity_blocks(
             affinity_text(request.path, body if isinstance(body, dict)
                           else {}))
-        rid = obs_flight.adopt_request_id(request.headers)
+        # Router flight timeline (router/flight.py): keyed by the SAME
+        # X-Request-ID forwarded below, so the router's record joins the
+        # replica's /debug/requests timeline and the engine's round
+        # grants by one ID.
+        tl = self.flight.begin_request(request.headers, request.path)
+        try:
+            return await self._forward_attempts(request, raw, blocks, tl)
+        except asyncio.CancelledError:
+            # Caller hung up while we were placing/connecting/streaming:
+            # retire the timeline (idempotent — a relay that already
+            # completed it wins) so the in-flight map can never leak.
+            self.flight.complete_request(tl, outcome="disconnect")
+            raise
+        except BaseException:
+            self.flight.complete_request(tl, outcome="error")
+            raise
+
+    async def _forward_attempts(self, request: web.Request, raw: bytes,
+                                blocks: Sequence[bytes],
+                                tl) -> web.StreamResponse:
+        rid = tl.request_id
         fwd_headers = {"X-Request-ID": rid}
         for h in _FORWARD_HEADERS:
             if h in request.headers and h not in fwd_headers:
@@ -232,8 +295,11 @@ class FleetRouter:
         tried: list[str] = []
         last_err: Optional[str] = None
         fallback: Optional[web.Response] = None
+        fallback_rep = ""
         for _ in range(self.retry_attempts):
-            rep = self.table.place(blocks, exclude=tried)
+            t_place = time.monotonic()
+            rep, decision = self.table.place_explained(blocks,
+                                                       exclude=tried)
             if rep is None:
                 break
             tried.append(rep.name)
@@ -241,6 +307,7 @@ class FleetRouter:
             # carries a donor hint — recomputed per attempt, since the
             # donor depends on who was chosen.
             fwd_headers.pop("X-KV-Transfer-From", None)
+            donor: Optional[str] = None
             if self.kv_transfer and blocks:
                 donor = self.table.transfer_donor(
                     blocks, chosen=rep.name,
@@ -249,6 +316,12 @@ class FleetRouter:
                     fwd_headers["X-KV-Transfer-From"] = donor
                     router_metrics.counter(
                         "router_kv_transfer_hints_total").inc()
+            self.flight.placement(
+                tl, replica=rep.name,
+                affinity_blocks=int(decision.get("affinity_blocks", 0)),
+                candidates=decision.get("candidates", []),
+                t_start=t_place, kv_donor=donor)
+            t_conn = time.monotonic()
             try:
                 faults.inject("router.forward", tag=rep.name)
                 assert self._session is not None
@@ -266,33 +339,53 @@ class FleetRouter:
                     rep.breaker.record_failure()
                     logger.warning("forward to %s failed post-connect: %s",
                                    rep.name, exc)
+                    self.flight.attempt_failed(
+                        tl, replica=rep.name, reason="post_connect",
+                        retried=False)
+                    self.flight.complete_request(
+                        tl, outcome="error", replica=rep.name, status=502)
                     return _error_response(
                         502, "replica_error",
                         f"replica {rep.name} failed: {exc}", rid)
                 rep.breaker.record_failure()
                 router_metrics.counter(
                     "router_retries_total", "connect").inc()
+                self.flight.attempt_failed(
+                    tl, replica=rep.name, reason="connect", retried=True)
                 last_err = f"{rep.name}: {exc}"
                 logger.info("connect to replica %s failed (%s); trying "
                             "next", rep.name, exc)
                 continue
+            # Connect + time-to-upstream-headers (for /generate the
+            # replica pulls the first chunk before committing to a 200,
+            # so this stage absorbs the replica-side TTFT work).
+            tl.stage("router_connect", time.monotonic() - t_conn)
             try:
                 return await self._relay(request, rep, upstream, rid,
-                                         blocks, tried)
+                                         blocks, tried, tl)
             except _RetryNextReplica as retry:
                 last_err = f"{rep.name}: {retry.reason}"
                 fallback = retry.response
+                fallback_rep = rep.name
+                self.flight.attempt_failed(
+                    tl, replica=rep.name, reason=retry.reason,
+                    retried=True)
                 continue
         if fallback is not None:
             # Every placeable replica refused as draining: relay the 429
             # — a rollout must look like backpressure to callers
             # (Retry-After and all), never a hard 502.
+            self.flight.complete_request(
+                tl, outcome="shed", replica=fallback_rep,
+                status=fallback.status)
             return fallback
         if not tried:
+            self.flight.complete_request(tl, outcome="shed", status=503)
             return _error_response(
                 503, "no_replicas",
                 "no placeable replica (all draining, unreachable, or "
                 "breaker-open)", rid, retry_after_s=self.heartbeat_s)
+        self.flight.complete_request(tl, outcome="error", status=502)
         return _error_response(
             502, "replica_error",
             f"all forward attempts failed (tried {', '.join(tried)}); "
@@ -301,9 +394,14 @@ class FleetRouter:
     async def _relay(self, request: web.Request, rep,
                      upstream: aiohttp.ClientResponse, rid: str,
                      blocks: Sequence[bytes],
-                     tried: Sequence[str]) -> web.StreamResponse:
+                     tried: Sequence[str],
+                     tl=None) -> web.StreamResponse:
         """Stream one upstream answer back; raises _RetryNextReplica for
-        the one retry-safe HTTP answer (429 draining, pre-work)."""
+        the one retry-safe HTTP answer (429 draining, pre-work). ``tl``
+        is the request's router timeline — first upstream body byte
+        stamps the router-observed TTFT, and the terminal transition
+        (stream end / mid-stream loss / caller disconnect / relayed
+        error status) retires it into the SLO window."""
         try:
             if upstream.status == 429:
                 data = await upstream.read()
@@ -327,9 +425,18 @@ class FleetRouter:
                         response=self._relay_body(upstream, data))
                 # Genuine backpressure (queue_full, deadline_unmeetable):
                 # relay — the Retry-After hint is the replica's to give.
+                self.flight.complete_request(
+                    tl, outcome="shed", replica=rep.name, status=429)
                 return self._relay_body(upstream, data)
             rep.breaker.record_success()
             if upstream.status >= 400:
+                # 503/504 are backpressure/deadline sheds in the replica
+                # taxonomy (docs/robustness.md); everything else relayed
+                # at >= 400 is an error outcome.
+                self.flight.complete_request(
+                    tl, outcome=("shed" if upstream.status in (503, 504)
+                                 else "error"),
+                    replica=rep.name, status=upstream.status)
                 return self._relay_body(upstream, await upstream.read())
             # 2xx: commit the placement (the sketch learns this prompt)
             # and stream the body through as it arrives.
@@ -346,6 +453,8 @@ class FleetRouter:
             # write failure is the CALLER hanging up, which says nothing
             # about the replica's health — misfiling it would let a few
             # impatient clients trip a healthy replica's breaker.
+            t_stream = time.monotonic()
+            outcome = "ok"
             chunks = upstream.content.iter_any()
             while True:
                 try:
@@ -363,6 +472,9 @@ class FleetRouter:
                     self.table.mark_unreachable(rep.name)
                     logger.warning("replica %s lost mid-stream: %s",
                                    rep.name, exc)
+                    outcome = "midstream_loss"
+                    if tl is not None:
+                        tl.event("midstream_loss", rep.name)
                     frame = (f"\n[error] replica {rep.name} lost "
                              f"mid-stream"
                              + "\n\nevent: error\ndata: " + json.dumps(
@@ -376,6 +488,9 @@ class FleetRouter:
                     except (ConnectionError, ConnectionResetError):
                         pass  # caller gone too
                     break
+                # First upstream body byte = the router-observed TTFT
+                # (idempotent; only the first chunk stamps it).
+                self.flight.first_byte(tl)
                 try:
                     await resp.write(chunk)
                 except (ConnectionError, ConnectionResetError) as exc:
@@ -385,11 +500,17 @@ class FleetRouter:
                     # replica sees the disconnect and cancels the
                     # generation instead of decoding to a dead socket.
                     upstream.close()
+                    outcome = "disconnect"
                     break
             try:
                 await resp.write_eof()
             except (ConnectionError, ConnectionResetError):
                 pass
+            if tl is not None:
+                tl.stage("router_stream", time.monotonic() - t_stream)
+            self.flight.complete_request(
+                tl, outcome=outcome, replica=rep.name,
+                status=upstream.status)
             return resp
         finally:
             upstream.release()
@@ -483,8 +604,25 @@ def create_router_app(replicas: Sequence[tuple[str, str]] = (), *,
 
     async def metrics_endpoint(request: web.Request) -> web.Response:
         from ..obs import metrics as obs_metrics
+        # Scrape-time refresh: heartbeat ages recompute from the live
+        # table, so a STALLED poller reads as a growing age — a frozen
+        # gauge would hide exactly the failure it exists to show.
+        table.publish_heartbeat_ages()
         return web.Response(text=obs_metrics.REGISTRY.render_prometheus(),
                             content_type="text/plain")
+
+    async def debug_requests(request: web.Request) -> web.Response:
+        # Router flight recorder: in-flight + last-N routed-request
+        # timelines (router/flight.py; same endpoint contract as the
+        # chain/model servers via the shared handler body).
+        return obs_flight.debug_requests_response(
+            request, recorder=router.flight)
+
+    async def debug_fleet(request: web.Request) -> web.Response:
+        # The fleet snapshot (router/fleet.py): per-replica rows + fleet
+        # totals + capacity headroom. Rebuilt from local state on every
+        # GET — never staler than the last heartbeat.
+        return web.json_response(router.refresh_fleet())
 
     async def list_replicas(request: web.Request) -> web.Response:
         return web.json_response({"replicas": table.snapshot(),
@@ -517,6 +655,7 @@ def create_router_app(replicas: Sequence[tuple[str, str]] = (), *,
     async def control_heartbeat(request: web.Request) -> web.Response:
         """Force one heartbeat cycle now (ops/tests)."""
         await router.heartbeat_once()
+        router.refresh_fleet()
         return web.json_response({"replicas": table.snapshot()})
 
     async def forward(request: web.Request) -> web.StreamResponse:
@@ -524,6 +663,8 @@ def create_router_app(replicas: Sequence[tuple[str, str]] = (), *,
 
     app.router.add_get("/health", health)
     app.router.add_get("/metrics", metrics_endpoint)
+    app.router.add_get("/debug/requests", debug_requests)
+    app.router.add_get("/debug/fleet", debug_fleet)
     app.router.add_get("/router/replicas", list_replicas)
     app.router.add_post("/control/replicas", control_replicas)
     app.router.add_post("/control/heartbeat", control_heartbeat)

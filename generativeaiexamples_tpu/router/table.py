@@ -76,8 +76,17 @@ class Replica:
     ready: bool = True          # ... and it was a 200 (drain/breaker -> 503)
     draining: bool = False
     load: dict = field(default_factory=dict)
+    # Fleet-observability blocks from the heartbeat body (chains/server
+    # ``/health``): the replica's round-telemetry rolling aggregates,
+    # its KV-tier counters, and its modeled decode capacity — folded
+    # into ``GET /debug/fleet`` (router/fleet.py), never into placement
+    # scoring (the ``load`` block above stays the scoring contract).
+    rounds: dict = field(default_factory=dict)
+    kv_tier: dict = field(default_factory=dict)
+    capacity: dict = field(default_factory=dict)
     recent_rejects: float = 0.0    # rejected_total diff between heartbeats
     last_heartbeat_t: float = 0.0
+    heartbeat_failures: int = 0    # probes that got no HTTP answer at all
     placements: int = 0            # committed placements (the metric)
     selections: int = 0            # place() picks — bumped at decision
     #                                time, under the table lock, so
@@ -98,9 +107,13 @@ class Replica:
             "draining": self.draining,
             "breaker": self.breaker.state, "placeable": self.placeable(),
             "load": dict(self.load),
+            "rounds": dict(self.rounds),
+            "kv_tier": dict(self.kv_tier),
+            "capacity": dict(self.capacity),
             "recent_rejects": self.recent_rejects,
             "placements": self.placements,
             "sketch_blocks": len(self.sketch),
+            "heartbeat_failures": self.heartbeat_failures,
             "heartbeat_age_s": (round(time.monotonic()
                                       - self.last_heartbeat_t, 3)
                                 if self.last_heartbeat_t else None),
@@ -210,12 +223,15 @@ class ReplicaTable:
 
     # ---------------------------------------------------------- placement
 
-    def _score(self, rep: Replica, blocks: Sequence[BlockHash]) -> float:
+    def _load_penalty(self, rep: Replica) -> float:
         load = rep.load
-        penalty = (self.queue_weight * float(load.get("queue_depth", 0))
-                   + self.inflight_weight * float(load.get("in_flight", 0))
-                   + self.shed_weight * rep.recent_rejects)
-        return self.affinity_weight * self._match(rep, blocks) - penalty
+        return (self.queue_weight * float(load.get("queue_depth", 0))
+                + self.inflight_weight * float(load.get("in_flight", 0))
+                + self.shed_weight * rep.recent_rejects)
+
+    def _score(self, rep: Replica, blocks: Sequence[BlockHash]) -> float:
+        return self.affinity_weight * self._match(rep, blocks) \
+            - self._load_penalty(rep)
 
     def place(self, blocks: Sequence[BlockHash] = (),
               exclude: Sequence[str] = ()) -> Optional[Replica]:
@@ -223,24 +239,53 @@ class ReplicaTable:
         ``blocks``. ``exclude`` names replicas already tried this
         request (the retry loop). Returns None when no placeable replica
         remains — the caller's 503."""
+        rep, _ = self.place_explained(blocks, exclude)
+        return rep
+
+    def place_explained(self, blocks: Sequence[BlockHash] = (),
+                        exclude: Sequence[str] = ()
+                        ) -> tuple[Optional[Replica], dict]:
+        """``place`` plus the decision evidence the router's flight
+        recorder stamps on the request timeline: every candidate's
+        score, affinity match, and load penalty inputs, and the chosen
+        replica's leading-block match — computed under the same lock as
+        the choice, so the explanation is exactly what the scorer saw."""
         with self._lock:
             candidates = [r for r in self._replicas.values()
                           if r.name not in exclude and r.placeable()]
+            decision: dict = {"policy": self.policy,
+                              "excluded": list(exclude),
+                              "candidates": []}
             if not candidates:
-                return None
+                return None, decision
+            # Score each candidate ONCE; the selection and the decision
+            # evidence read the same tuples (no hot-path recompute).
+            scored = [(r, self._match(r, blocks)) for r in candidates]
+            scored = [(r, m, self.affinity_weight * m
+                       - self._load_penalty(r)) for r, m in scored]
             if self.policy == "round_robin":
-                chosen = min(candidates,
-                             key=lambda r: (r.selections, r.name))
+                chosen, chosen_match, _ = min(
+                    scored, key=lambda t: (t[0].selections, t[0].name))
             else:
                 # Max score; ties rotate to the least-selected candidate
                 # so a no-affinity workload degenerates to
                 # least-loaded-then-RR instead of pinning the
                 # dict-order-first replica.
-                chosen = max(candidates,
-                             key=lambda r: (self._score(r, blocks),
-                                            -r.selections, r.name))
+                chosen, chosen_match, _ = max(
+                    scored, key=lambda t: (t[2], -t[0].selections,
+                                           t[0].name))
+            for r, match, score in scored:
+                decision["candidates"].append({
+                    "replica": r.name,
+                    "score": round(score, 3),
+                    "affinity_blocks": match,
+                    "queue_depth": int(r.load.get("queue_depth", 0)),
+                    "in_flight": int(r.load.get("in_flight", 0)),
+                })
+            decision["replica"] = chosen.name
+            decision["affinity_blocks"] = chosen_match
             chosen.selections += 1
-            return chosen
+            return chosen, decision
 
     def transfer_donor(self, blocks: Sequence[BlockHash], chosen: str,
                        min_blocks: int = 2) -> Optional[str]:
@@ -287,8 +332,19 @@ class ReplicaTable:
             rep.last_heartbeat_t = time.monotonic()
             rep.reachable = ok
             rep.ready = ok and ready
+            if not ok:
+                # The blind spot PR 12 closes: a failed probe used to
+                # flip the replica silently — count it where dashboards
+                # can see a partition (or a stalled replica) building.
+                rep.heartbeat_failures += 1
             if ok and body is not None:
                 rep.draining = bool(body.get("draining", False))
+                # Fleet-observability blocks ride the same heartbeat;
+                # absent blocks (engineless chains, older replicas)
+                # clear so /debug/fleet never shows stale telemetry.
+                rep.rounds = dict(body.get("rounds") or {})
+                rep.kv_tier = dict(body.get("kv_tier") or {})
+                rep.capacity = dict(body.get("capacity") or {})
                 load = body.get("load") or {}
                 # recent_rejects is a between-heartbeats DIFF, so the
                 # first observation is baseline only — a long-running
@@ -301,9 +357,31 @@ class ReplicaTable:
                     cur = float(load.get("rejected_total", prev))
                     rep.recent_rejects = max(0.0, cur - float(prev))
                 rep.load = dict(load)
-        if ok and body is not None:
-            router_metrics.record_replica_load(name, body.get("load") or {})
+        if ok:
+            if body is not None:
+                router_metrics.record_replica_load(name,
+                                                   body.get("load") or {})
+        else:
+            # Mirrors Replica.heartbeat_failures exactly: only probes
+            # that got NO HTTP answer count (a reachable replica with a
+            # non-JSON body is a different problem, not a partition).
+            router_metrics.counter(
+                "router_heartbeat_failures_total", name).inc()
         self._publish_counts()
+        self.publish_heartbeat_ages()
+
+    def publish_heartbeat_ages(self) -> None:
+        """Refresh ``router_heartbeat_age_seconds{replica=}`` from the
+        live table — called on every heartbeat observation AND at
+        /metrics scrape time, so a STALLED poller shows as a growing
+        age instead of a frozen gauge."""
+        now = time.monotonic()
+        for rep in self.replicas():
+            age = (now - rep.last_heartbeat_t) if rep.last_heartbeat_t \
+                else -1.0
+            router_metrics.gauge(
+                "router_heartbeat_age_seconds", rep.name).set(
+                round(age, 3))
 
     def mark_unreachable(self, name: str) -> None:
         with self._lock:

@@ -92,6 +92,21 @@ def test_fleet_bench_end_to_end(fleet_section):
     assert transfer["kv_transfer"] is True
     assert transfer["kv_transfer_pages"] == 0
     assert not rr["kv_transfer"] and not aff["kv_transfer"]
+    # fleet_obs rides along, sourced from the router's /debug/fleet and
+    # schema-validated at capture time (None would mean the capture
+    # failed — the spine is part of the scenario's contract)
+    obs = section["fleet_obs"]
+    assert obs is not None
+    assert obs["window_requests"] > 0
+    assert obs["slo_attainment"] is not None
+    assert obs["capacity_tokens_per_sec"] > 0
+    assert len(obs["replicas"]) == 2
+    for row in obs["replicas"]:
+        assert row["headroom_tokens_per_sec"] is not None
+        # window rows cover the arm's turns; headroom never exceeds the
+        # replica's modeled capacity share
+        assert row["headroom_tokens_per_sec"] \
+            <= obs["capacity_tokens_per_sec"]
 
 
 def test_fleet_section_schema_valid(fleet_section):

@@ -44,6 +44,37 @@ def test_extract_flattens_headline_metrics():
     assert extract_metrics({"parsed": _result()})["hbm_bw_util"][0] == 0.72
 
 
+def test_extract_fleet_policy_metrics_direction_aware():
+    """Fleet arms contribute per-policy headline metrics (ISSUE 12): a
+    cross-replica prefix-hit or SLO regression in one arm is gated like
+    any single-replica headline, and a warm-TTFT rise is wrong-way."""
+    result = _result(fleet={"policies": [
+        {"policy": "round_robin", "prefix_hit_rate": 0.05,
+         "slo_attainment": 0.90, "ttft_p50_ms": 120.0,
+         "kv_transfer_pages": 0},
+        {"policy": "affinity_transfer", "prefix_hit_rate": 0.62,
+         "slo_attainment": 0.99, "ttft_p50_ms": 45.0,
+         "kv_transfer_pages": 12},
+    ]})
+    m = extract_metrics(result)
+    assert m["fleet.prefix_hit_rate@affinity_transfer"] == (0.62, "higher")
+    assert m["fleet.slo_attainment@round_robin"] == (0.90, "higher")
+    assert m["fleet.ttft_p50_ms@affinity_transfer"] == (45.0, "lower")
+    assert m["fleet.kv_transfer_pages@affinity_transfer"] == (12, "higher")
+    # direction-aware comparison: a prefix-hit drop regresses, a TTFT
+    # drop improves
+    worse = extract_metrics(_result(fleet={"policies": [
+        {"policy": "affinity_transfer", "prefix_hit_rate": 0.30,
+         "slo_attainment": 0.99, "ttft_p50_ms": 30.0,
+         "kv_transfer_pages": 12},
+    ]}))
+    regressions, notes = compare(m, worse)
+    assert any("fleet.prefix_hit_rate@affinity_transfer" in r
+               for r in regressions)
+    assert any(n.startswith("improved fleet.ttft_p50_ms")
+               for n in notes)
+
+
 def test_extract_tolerates_missing_sections():
     m = extract_metrics({"decode_tokens_per_sec": 100.0, "chat": {}})
     assert set(m) == {"decode_tokens_per_sec"}

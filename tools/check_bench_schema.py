@@ -118,6 +118,24 @@ def validate_result(result: dict, schema: dict | None = None) -> None:
                 else:
                     errors.append(
                         f"fleet.policies[{i}]: {entry!r} is not an object")
+        # Fleet-observability block sourced from the router's
+        # /debug/fleet (per-replica SLO attainment + capacity headroom)
+        # — element-wise like every other nested headline block.
+        obs = fleet.get("fleet_obs")
+        if isinstance(obs, dict):
+            _check_types("fleet.fleet_obs", obs, schema["fleet_obs"],
+                         errors)
+            reps = obs.get("replicas")
+            if isinstance(reps, list):
+                for i, entry in enumerate(reps):
+                    if isinstance(entry, dict):
+                        _check_types(f"fleet.fleet_obs.replicas[{i}]",
+                                     entry, schema["fleet_obs_replica"],
+                                     errors)
+                    else:
+                        errors.append(
+                            f"fleet.fleet_obs.replicas[{i}]: {entry!r} "
+                            f"is not an object")
     # Capacity sweep: each slot rung carries the TTFT/throughput/HBM-
     # roofline headline fields — validated element-wise so a rename in
     # one rung's dict can't hide behind the list type.

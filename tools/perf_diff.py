@@ -29,6 +29,13 @@ metric                                  direction  source
 ``goodput_tokens_per_sec@<rps>``        higher     openloop, per rate
 ``spec.tokens_per_step``                higher     chat/openloop spec
                                                    block (first present)
+``fleet.prefix_hit_rate@<policy>``      higher     fleet scenario, per
+                                                   placement-policy arm
+``fleet.slo_attainment@<policy>``       higher     fleet, per policy arm
+``fleet.ttft_p50_ms@<policy>``          lower      fleet, per policy arm
+``fleet.kv_transfer_pages@<policy>``    higher     fleet, per policy arm
+                                                   (transfer arms only —
+                                                   a 0 baseline skips)
 ======================================  =========  =====================
 
 Accepts raw bench results or the driver's artifact wrapper (an object
@@ -57,6 +64,13 @@ HEADLINE_METRICS: dict[str, str] = {
 _OPENLOOP_DIRECTIONS = {"slo_attainment": "higher",
                         "goodput_tokens_per_sec": "higher"}
 _SPEC_DIRECTION = ("spec.tokens_per_step", "higher")
+#: Fleet-scenario headline metrics, per placement-policy arm — the
+#: cross-replica numbers the router exists to move, gated with the same
+#: direction-aware thresholds as the single-replica headlines.
+_FLEET_DIRECTIONS = {"prefix_hit_rate": "higher",
+                     "slo_attainment": "higher",
+                     "ttft_p50_ms": "lower",
+                     "kv_transfer_pages": "higher"}
 
 DEFAULT_THRESHOLD_PCT = 5.0
 
@@ -104,6 +118,18 @@ def extract_metrics(result: dict) -> dict[str, tuple[float, str]]:
             v = _num(block.get("tokens_per_step"))
             if v is not None and _SPEC_DIRECTION[0] not in out:
                 out[_SPEC_DIRECTION[0]] = (v, _SPEC_DIRECTION[1])
+    fleet = result.get("fleet")
+    if isinstance(fleet, dict):
+        for entry in fleet.get("policies") or []:
+            if not isinstance(entry, dict):
+                continue
+            policy = entry.get("policy")
+            if not policy:
+                continue
+            for key, direction in _FLEET_DIRECTIONS.items():
+                v = _num(entry.get(key))
+                if v is not None:
+                    out[f"fleet.{key}@{policy}"] = (v, direction)
     return out
 
 

@@ -26,6 +26,10 @@ Checks:
 - **metrics-lint** — every declared metric surface renders a clean
   Prometheus exposition (HELP lines, family matching, ``_total``
   counters).
+- **fleet-obs** — the router's ``GET /debug/fleet`` snapshot and
+  ``/debug/requests`` timeline contracts (router/fleet.py schemas)
+  validated element-wise over a synthetic-but-real router state built
+  through the production table/recorder/window classes.
 - **perf-gates** — ``tools/perf_diff.py`` over committed artifact
   pairs: each later round must not regress the earlier one's headline
   metrics (the same pairs/thresholds the tier-1 perf_diff test pins).
@@ -89,6 +93,17 @@ def check_bench_schema() -> list[str]:
              "kv_transfer": p == "affinity_transfer",
              "kv_transfer_pages": 4 if p == "affinity_transfer" else 0}
             for p in ("round_robin", "affinity", "affinity_transfer")],
+        "fleet_obs": {
+            "slo_attainment": 1.0, "window_requests": 9,
+            "ttft_p50_ms": 10.0, "error_rate": 0.0,
+            "headroom_tokens_per_sec": 120.0,
+            "capacity_tokens_per_sec": 200.0,
+            "replicas": [
+                {"name": f"r{i}", "slo_attainment": 1.0,
+                 "window_requests": 4 + i,
+                 "headroom_tokens_per_sec": 60.0}
+                for i in range(2)],
+        },
     }
     result = bench.assemble_result(
         kind="engine", model="preflight", headline=10.0,
@@ -143,16 +158,93 @@ def check_metrics_lint() -> list[str]:
                        else obs_metrics.STAGE_BUCKETS)
             reg.histogram(name, help_txt, buckets=buckets).observe(1.0)
     for name, (kind, labels, help_txt) in ROUTER_METRICS.items():
-        m = (reg.counter if kind == "counter" else reg.gauge)(
-            name, help_txt, labelnames=labels)
+        if kind == "histogram":
+            m = reg.histogram(name, help_txt,
+                              buckets=obs_metrics.STAGE_BUCKETS,
+                              labelnames=labels)
+        else:
+            m = (reg.counter if kind == "counter" else reg.gauge)(
+                name, help_txt, labelnames=labels)
         leaf = m.labels(*(["r0"] * len(labels))) if labels else m
-        leaf.inc() if kind == "counter" else leaf.set(1.0)
+        if kind == "counter":
+            leaf.inc()
+        elif kind == "gauge":
+            leaf.set(1.0)
+        else:
+            leaf.observe(0.1)
     reg.counter("shed_total", "requests rejected at admission, by reason",
                 labelnames=("reason",)).labels("queue_full").inc()
     reg.gauge("breaker_state",
               "circuit breaker state (0 closed, 1 half-open, 2 open)",
               labelnames=("name",)).labels("retrieval").set(0)
     return obs_metrics.lint_prometheus(reg.render_prometheus())
+
+
+def synthetic_fleet_state():
+    """A small but fully-populated router state (table + SLO window +
+    flight recorder) built through the REAL production classes — what
+    the fleet-obs check below snapshots and validates. Returning the
+    parts lets the tier-1 test doctor copies to prove the check can
+    fail."""
+    from generativeaiexamples_tpu.router.flight import (
+        RouterFlightRecorder, SloWindow)
+    from generativeaiexamples_tpu.router.table import ReplicaTable
+
+    table = ReplicaTable()
+    table.add("r0", "http://r0:8081")
+    table.add("r1", "http://r1:8081")
+    table.update_health("r0", ok=True, body={
+        "draining": False,
+        "load": {"in_flight": 2, "queue_depth": 3, "rejected_total": 1,
+                 "prefix_hit_rate": 0.6},
+        "rounds": {"rounds_completed": 10, "tokens_per_sec": 400.0,
+                   "wall_tokens_per_sec": 120.0, "avg_device_ms": 8.0,
+                   "avg_bw_util": 0.4, "avg_drift_ratio": 1.1,
+                   "interleaved_share": 0.3},
+        "capacity": {"slots": 8, "decode_step_ms": 2.0,
+                     "model_source": "PROFILE_r09.json",
+                     "capacity_tokens_per_sec": 4000.0},
+        "kv_tier": {"host_pages": 5, "offload_pages": 9,
+                    "restore_pages": 4, "transfer_pages": 2},
+    })
+    table.update_health("r1", ok=False)   # a partitioned sibling
+    slo = SloWindow(window_s=600.0)
+    recorder = RouterFlightRecorder(slo=slo)
+    tl = recorder.begin_request(
+        {"X-Request-ID": "preflight-1", "X-Deadline-Ms": "5000"},
+        "/generate")
+    recorder.placement(tl, replica="r0", affinity_blocks=2,
+                       candidates=[{"replica": "r0", "score": 3.0,
+                                    "affinity_blocks": 2,
+                                    "queue_depth": 3, "in_flight": 2}],
+                       t_start=tl.t_start)
+    recorder.attempt_failed(tl, replica="r1", reason="connect",
+                            retried=True)
+    recorder.first_byte(tl)
+    recorder.complete_request(tl, outcome="ok", replica="r0", status=200)
+    slo.record(replica="r1", outcome="midstream_loss", ttft_ms=50.0)
+    slo.record(replica="r0", outcome="shed")
+    return table, slo, recorder, tl
+
+
+def check_fleet_obs() -> list[str]:
+    """Validate the ``GET /debug/fleet`` snapshot and the router
+    ``/debug/requests`` timeline contracts over a synthetic-but-real
+    router state, element-wise (router/fleet.py schemas)."""
+    sys.path.insert(0, REPO)
+    from generativeaiexamples_tpu.router import fleet as router_fleet
+
+    table, slo, _recorder, tl = synthetic_fleet_state()
+    snap = router_fleet.build_fleet_snapshot(table, slo, heartbeat_s=2.0)
+    errors = router_fleet.validate_fleet_snapshot(snap)
+    errors.extend(router_fleet.validate_router_timeline(tl.to_dict()))
+    # The synthetic state exercises every outcome class: an all-empty
+    # window would validate while proving nothing.
+    if snap["fleet"]["window_requests"] < 3:
+        errors.append("synthetic fleet state produced an empty SLO "
+                      "window — the check is no longer exercising the "
+                      "outcome path")
+    return errors
 
 
 def check_perf_gates(pairs=None) -> list[str]:
@@ -179,6 +271,7 @@ CHECKS: dict[str, Callable[[], list[str]]] = {
     "bench-schema": check_bench_schema,
     "metrics-docs": check_metrics_docs,
     "metrics-lint": check_metrics_lint,
+    "fleet-obs": check_fleet_obs,
     "perf-gates": check_perf_gates,
 }
 
