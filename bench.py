@@ -26,6 +26,9 @@ Env knobs: BENCH_MODEL (default llama-2-7b-chat), BENCH_QUANT (int8 default
 docs/rag/support_matrix.md:4-12 — none|int8|int4 to override),
 BENCH_PROMPT_LEN, BENCH_OUTPUT_LEN, BENCH_REQUESTS, BENCH_SLOTS,
 BENCH_STEPS_PER_ROUND, BENCH_DISPATCH_DEPTH, BENCH_SKIP_E2E,
+BENCH_AUTOSCALE (=1 runs the diurnal-trace autoscale scenario —
+docs/autoscaling.md; BENCH_AUTOSCALE_REPLICAS/SECONDS/TRACE/MIN/
+TOKENS/INTERVAL_S/DEADLINE_MS refine it),
 BENCH_SKIP_CHAT, BENCH_CHAT_TURNS, BENCH_CHAT_SYSTEM (multi-turn chat
 scenario: warm shared-prefix TTFT vs cold, engine prefix cache);
 BENCH_MODEL_PATH points at a real checkpoint dir (weights + tokenizer
@@ -914,6 +917,279 @@ def run_fleet_bench(engines, *, sessions=6, turns=4, session_rps=2.0,
     }
 
 
+def parse_trace(spec: str) -> list[tuple[float, float]]:
+    """``frac:rps,frac:rps,...`` — the diurnal arrival trace shape
+    (fractions of the run's duration; they need not sum to 1, they are
+    normalized). Example: ``0.3:1,0.3:6,0.4:1`` is a quiet-burst-quiet
+    day compressed into one run."""
+    phases = []
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        frac, _, rps = entry.partition(":")
+        phases.append((float(frac), float(rps)))
+    if not phases:
+        raise ValueError(f"empty trace spec {spec!r}")
+    total = sum(f for f, _ in phases)
+    return [(f / total, r) for f, r in phases]
+
+
+def run_autoscale_bench(engines, *, duration_s=12.0,
+                        trace=((0.3, 1.0), (0.3, 6.0), (0.4, 1.0)),
+                        slo_ttft_ms=2000.0, deadline_ms=None,
+                        num_tokens=8, min_replicas=1, interval_s=0.3,
+                        heartbeat_s=0.25, seed=0, prompt_chars=400):
+    """Autoscale scenario (``BENCH_AUTOSCALE=1``): a diurnal/bursty
+    open-loop arrival trace through the fleet router, run twice —
+    **autoscaled** (start at ``min_replicas``; the SLO-driven controller
+    activates parked replicas on leading indicators and drains them
+    back when the burst passes, docs/autoscaling.md) vs **static** (a
+    fixed fleet sized to the autoscaled arm's AVERAGE replica count, so
+    both arms spend the same replica-minutes and the delta is purely
+    WHEN the capacity existed).
+
+    Headline per arm: **slo_attainment** (offered requests that
+    completed ok with TTFT under ``slo_ttft_ms``) and **replica_minutes**
+    (the integral of active replica count over the run — the bill). On
+    a bursty trace the autoscaled arm should beat the equal-average
+    static baseline: capacity concentrated under the burst attains more
+    than capacity spread evenly.
+
+    ``engines`` is the FULL fleet (the autoscale ceiling); arrivals are
+    Poisson within each trace phase, every request unique-content (cold
+    prefill — TTFT differences measure capacity, not cache luck).
+    """
+    import statistics
+
+    import numpy as _np
+    import requests
+
+    from generativeaiexamples_tpu.chains.examples.developer_rag import (
+        QAChatbot)
+    from generativeaiexamples_tpu.chains.llm import EngineLLM
+    from generativeaiexamples_tpu.chains.server import create_app
+    from generativeaiexamples_tpu.embed.encoder import HashEmbedder
+    from generativeaiexamples_tpu.router import autoscale as _rauto
+    from generativeaiexamples_tpu.router.server import create_router_app
+    from generativeaiexamples_tpu.router.table import ReplicaTable
+    from generativeaiexamples_tpu.utils.app_config import AppConfig
+    from generativeaiexamples_tpu.utils.configuration import from_dict
+
+    trace = [(float(f), float(r)) for f, r in trace]
+    cfg = from_dict(AppConfig, {
+        "llm": {"model_engine": "tpu-jax"},
+        "embeddings": {"model_engine": "hash", "dimensions": 32},
+    })
+    for eng in engines:
+        eng.start()
+    apps = [create_app(QAChatbot(llm=EngineLLM(eng),
+                                 embedder=HashEmbedder(dim=32),
+                                 config=cfg, fused_rag=False), config=cfg)
+            for eng in engines]
+    replica_urls, stop_replicas = serve_apps(apps)
+    names = [f"r{i}" for i in range(len(engines))]
+    pairs = list(zip(names, replica_urls))
+    max_replicas = len(engines)
+    min_replicas = max(1, min(int(min_replicas), max_replicas))
+
+    def arrivals(label: str) -> list[tuple[float, str]]:
+        """(t_offset, unique_prompt) per offered request."""
+        rng = _np.random.RandomState(seed)
+        out = []
+        t0 = 0.0
+        uid = 0
+        for frac, rps in trace:
+            span = duration_s * frac
+            t = t0
+            while True:
+                t += float(rng.exponential(1.0 / max(1e-6, rps)))
+                if t >= t0 + span:
+                    break
+                out.append((t, f"[{label}-{seed}-{uid}] "
+                               + "q" * max(1, prompt_chars)))
+                uid += 1
+            t0 += span
+        return out
+
+    def one_arm(label: str, initial: int,
+                autoscaled: bool) -> dict:
+        table = ReplicaTable(policy="affinity")
+
+        def factory(router):
+            executor = _rauto.LocalExecutor(
+                router, pairs[initial:], drain_wait_s=15.0)
+            policy = _rauto.AutoscalePolicy(
+                min_replicas=min_replicas, max_replicas=max_replicas,
+                interval_s=interval_s, up_cooldown_s=2 * interval_s,
+                down_cooldown_s=4 * interval_s, down_stable_ticks=3,
+                drain_wait_s=15.0)
+            return _rauto.AutoscaleController(
+                router, policy=policy, executor=executor,
+                surge=router.surge, slo_ttft_ms=slo_ttft_ms)
+
+        router_app = create_router_app(
+            pairs[:initial], table=table, heartbeat_s=heartbeat_s,
+            run_heartbeat=True,
+            autoscale_factory=factory if autoscaled else None,
+            run_autoscale=autoscaled)
+        (router_url,), stop_router = serve_apps([router_app])
+        rows: list[dict] = []
+        rows_lock = threading.Lock()
+
+        def fire(prompt: str):
+            t0 = time.monotonic()
+            row = {"ok": False, "status": None, "ttft_ms": None}
+            headers = {}
+            if deadline_ms:
+                headers["X-Deadline-Ms"] = str(int(deadline_ms))
+            try:
+                with requests.post(
+                        f"{router_url}/generate",
+                        json={"question": prompt, "context": "",
+                              "use_knowledge_base": False,
+                              "num_tokens": num_tokens},
+                        headers=headers, stream=True,
+                        timeout=120) as resp:
+                    row["status"] = resp.status_code
+                    if resp.status_code == 200:
+                        body = b""
+                        it = resp.iter_content(chunk_size=1)
+                        for b in it:
+                            body = b
+                            row["ttft_ms"] = (time.monotonic() - t0) * 1e3
+                            break
+                        for b in it:
+                            body += b
+                        text = body.decode("utf-8", errors="replace")
+                        row["ok"] = "[error]" not in text
+            except requests.RequestException as exc:
+                row["error"] = str(exc)
+            with rows_lock:
+                rows.append(row)
+
+        # Replica-count sampler: the replica_minutes integral. Samples
+        # the TABLE (members, draining included — a draining replica
+        # still holds its resources until its streams finish).
+        samples: list[int] = []
+        stop_sampling = threading.Event()
+
+        def sample():
+            while not stop_sampling.wait(0.05):
+                samples.append(len(table.replicas()))
+
+        sampler = threading.Thread(target=sample, daemon=True)
+        sampler.start()
+        plan = arrivals(label)
+        threads = []
+        t_start = time.monotonic()
+        for t_off, prompt in plan:
+            delay = t_start + t_off - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            th = threading.Thread(target=fire, args=(prompt,),
+                                  daemon=True)
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join(timeout=180)
+        elapsed = time.monotonic() - t_start
+        stop_sampling.set()
+        sampler.join(timeout=5)
+        autoscale_snap = None
+        if autoscaled:
+            try:
+                snap = requests.get(f"{router_url}/debug/autoscale",
+                                    timeout=30).json()
+                errs = _rauto.validate_autoscale_snapshot(snap)
+                if errs:
+                    raise ValueError("; ".join(errs))
+                autoscale_snap = snap
+            except Exception as exc:  # noqa: BLE001 — evidence block
+                sys.stderr.write(
+                    f"bench: autoscale snapshot capture failed: {exc}\n")
+        stop_router()
+        avg_replicas = (sum(samples) / len(samples)) if samples \
+            else float(initial)
+        replica_minutes = avg_replicas * elapsed / 60.0
+        offered = len(plan)
+        ok_rows = [r for r in rows if r["ok"]]
+        met = [r for r in ok_rows
+               if r["ttft_ms"] is not None
+               and r["ttft_ms"] <= slo_ttft_ms]
+        ttfts = sorted(r["ttft_ms"] for r in ok_rows
+                       if r["ttft_ms"] is not None)
+        totals = (autoscale_snap or {}).get("decisions_total", {})
+        surge = (autoscale_snap or {}).get("surge", {})
+        return {
+            "policy": label,
+            "replicas_static": None if autoscaled else initial,
+            "offered": offered,
+            "completed": len(ok_rows),
+            "shed": sum(1 for r in rows if r["status"] == 429),
+            "errors": sum(1 for r in rows
+                          if not r["ok"] and r["status"] != 429),
+            "slo_attainment": round(len(met) / max(1, offered), 4),
+            "ttft_p50_ms": (round(statistics.median(ttfts), 2)
+                            if ttfts else None),
+            "replica_minutes": round(replica_minutes, 4),
+            "avg_replicas": round(avg_replicas, 3),
+            "peak_replicas": max(samples) if samples else initial,
+            "scale_ups": int(totals.get("scale_up", 0)),
+            "scale_downs": int(totals.get("scale_down", 0)),
+            "surge_rejections": int(sum(
+                (surge.get("rejected") or {}).values())),
+            "decisions": int(sum(totals.values())),
+        }
+
+    def reset_engines():
+        for eng in engines:
+            try:
+                eng.reset()
+            except Exception:  # noqa: BLE001 — comparability only
+                pass
+        # The autoscaled arm's scale-downs DRAINED parked replicas —
+        # app-level DrainState the engine reset cannot see. The static
+        # arm's fleet must start with admission open everywhere, or its
+        # "N replicas" silently run as fewer and the headline
+        # comparison measures drain debris instead of capacity timing.
+        for url in replica_urls:
+            try:
+                requests.post(f"{url}/control/undrain", timeout=10)
+            except requests.RequestException:
+                pass
+
+    # Mask the env switch for the arm matrix: the AUTOSCALED arm gets
+    # its controller from the explicit factory, and the STATIC arm must
+    # not grow one from a stray ROUTER_AUTOSCALE in the environment.
+    saved_env = os.environ.pop("ROUTER_AUTOSCALE", None)
+    try:
+        auto_row = one_arm("autoscaled", min_replicas, autoscaled=True)
+        # Equal-average static baseline: the same replica-minutes budget
+        # spread evenly — the honest comparison (a static fleet at max
+        # would trivially win attainment by spending more).
+        static_n = min(max_replicas,
+                       max(min_replicas,
+                           int(round(auto_row["avg_replicas"]))))
+        reset_engines()
+        static_row = one_arm("static", static_n, autoscaled=False)
+    finally:
+        if saved_env is not None:
+            os.environ["ROUTER_AUTOSCALE"] = saved_env
+        stop_replicas()
+    return {
+        "duration_s": float(duration_s),
+        "trace": [[f, r] for f, r in trace],
+        "slo_ttft_ms": float(slo_ttft_ms),
+        "deadline_ms": float(deadline_ms) if deadline_ms else None,
+        "num_tokens": int(num_tokens),
+        "min_replicas": int(min_replicas),
+        "max_replicas": int(max_replicas),
+        "interval_s": float(interval_s),
+        "policies": [auto_row, static_row],
+    }
+
+
 def run_kv_pressure_bench(params, model_cfg, tokenizer, *,
                           ratios=(1, 2, 4), pool_tokens=None,
                           host_pool_tokens=None, turns=3,
@@ -1101,7 +1377,7 @@ def assemble_result(*, kind, model, headline, engine_p50, engine_p99, tput,
                     kv_pool_pages, device, rtt_ms, n_devices,
                     bench_seconds, e2e_tps_p50=None, openloop=None,
                     fleet=None, capacity=None, rounds=None,
-                    kv_pressure=None) -> dict:
+                    kv_pressure=None, autoscale=None) -> dict:
     """The bench's single output contract. Every field name here is
     pinned by tools/bench_schema.json (validated at emit time AND by the
     tier-1 suite, tests/test_bench_schema.py) so a rename fails fast
@@ -1157,6 +1433,11 @@ def assemble_result(*, kind, model, headline, engine_p50, engine_p99, tput,
         # working sets N× the KV pool, host tiering off vs on — warm
         # TTFT + restore hit rate per arm. Null when not requested.
         "kv_pressure": kv_pressure,
+        # Autoscale scenario (BENCH_AUTOSCALE=1): diurnal/bursty arrival
+        # trace through the router, SLO-driven autoscaling vs an
+        # equal-average static fleet — slo_attainment + replica_minutes
+        # per arm (docs/autoscaling.md). Null when not requested.
+        "autoscale": autoscale,
         "quantization": quant,
         "kv_quant": kv_quant,
         "weights": weights,
@@ -1612,6 +1893,45 @@ def main() -> None:
                 except Exception:  # noqa: BLE001
                     pass
 
+    # Autoscale scenario (BENCH_AUTOSCALE=1): the diurnal trace through
+    # the router, autoscaled vs equal-average static. Fresh small
+    # replica engines over the measured params (the full fleet is the
+    # autoscale ceiling), main engine stopped. Degrades to null.
+    autoscale = None
+    if os.environ.get("BENCH_AUTOSCALE", "") not in ("", "0"):
+        as_engines = []
+        try:
+            n_as = int(os.environ.get("BENCH_AUTOSCALE_REPLICAS", "")
+                       or max(3, n_rep))
+            as_engines = build_fleet_engines(
+                engine.params, model_cfg, engine.tokenizer, n_as)
+            autoscale = run_autoscale_bench(
+                as_engines,
+                duration_s=float(os.environ.get(
+                    "BENCH_AUTOSCALE_SECONDS", "12")),
+                trace=parse_trace(os.environ.get(
+                    "BENCH_AUTOSCALE_TRACE", "0.3:1,0.3:6,0.4:1")),
+                slo_ttft_ms=float(os.environ.get(
+                    "BENCH_SLO_TTFT_MS", "2000")),
+                deadline_ms=float(os.environ.get(
+                    "BENCH_AUTOSCALE_DEADLINE_MS", "0")) or None,
+                num_tokens=int(os.environ.get(
+                    "BENCH_AUTOSCALE_TOKENS", "8")),
+                min_replicas=int(os.environ.get(
+                    "BENCH_AUTOSCALE_MIN", "1")),
+                interval_s=float(os.environ.get(
+                    "BENCH_AUTOSCALE_INTERVAL_S", "0.3")),
+                seed=int(os.environ.get("BENCH_SEED", "0")))
+        except Exception as exc:  # noqa: BLE001
+            sys.stderr.write(f"bench: autoscale scenario failed: "
+                             f"{exc}\n")
+        finally:
+            for e in as_engines:
+                try:
+                    e.stop()
+                except Exception:  # noqa: BLE001
+                    pass
+
     import jax
     # Headline = the full QA-chatbot path (BASELINE.json's north star is
     # the *chatbot* TTFT, not the engine-only number — VERDICT r3 weak
@@ -1626,6 +1946,7 @@ def main() -> None:
         e2e_breakdown=e2e_breakdown, e2e_tps_p50=e2e_tps_p50,
         pipeline=pipeline, openloop=openloop, fleet=fleet,
         capacity=capacity, rounds=rounds, kv_pressure=kv_pressure,
+        autoscale=autoscale,
         quant=quant, kv_quant=engine.cfg.kv_quant or None,
         weights=("real" if os.environ.get("BENCH_MODEL_PATH")
                  else "random-init"),

@@ -201,8 +201,16 @@ def create_app(example: BaseExample,
         if engine is not None:
             try:
                 stats = engine.stats
+                # Queued WORK, not just in-flight device rounds: the
+                # engine's queue_waiting stat (admission intake +
+                # scheduler backlog) is the leading congestion signal
+                # the router's load score and the autoscaler's queue
+                # trigger both need — device rounds alone saturate at
+                # dispatch_depth and read "2" on a replica drowning in
+                # queued prefills.
                 load["queue_depth"] = int(
-                    stats.get("dispatch_queue_depth", 0))
+                    stats.get("dispatch_queue_depth", 0)
+                    + stats.get("queue_waiting", 0))
                 # Admission-pressure counters: the router diffs these
                 # between heartbeats into a recent shed rate.
                 load["rejected_total"] = int(
@@ -325,10 +333,17 @@ def create_app(example: BaseExample,
 
     def _drain_reject(rid: str) -> web.Response:
         _shed("draining")
+        # Retry-After from the flight recorder's MEASURED queue-wait
+        # estimate (the same signal edge admission sheds on), not a
+        # constant: a drained-but-idle replica tells retries to come
+        # back in a second, a congested one spaces them to its actual
+        # drain time.
+        _, wait_ms = obs_flight.RECORDER.recent_stage_ms(
+            "engine_admit_pickup")
         return error_response(
             429, "draining",
             "replica is draining; retry against another replica", rid,
-            retry_after_s=1.0)
+            retry_after_s=max(1.0, wait_ms / 1e3))
 
     @instrumented("upload_document")
     async def upload_document(request: web.Request) -> web.Response:

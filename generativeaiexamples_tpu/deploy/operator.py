@@ -194,3 +194,58 @@ class PipelineOperator:
             n += bool(self.kube.delete(obj_key(obj)))
         self.kube.delete(self._state_key(pipeline))
         return n
+
+
+def set_scale_target(kube: KubeInterface, *, namespace: str,
+                     pipeline: str, release: str, replicas: int,
+                     values_path: tuple[str, ...] = ("replicas",)) -> dict:
+    """Scale one chart of a HelmPipeline through the CR — the
+    autoscaler's k8s write path (router/autoscale.py
+    ``KubeOperatorExecutor``).
+
+    Reads the live CR, sets the named package's
+    ``chartValues.<values_path>`` to ``replicas`` (e.g.
+    ``("chainServer", "replicas")`` for the first-party chart's
+    chain-server Deployment), and writes it back **carrying the
+    resourceVersion the read observed** — the apiserver's optimistic
+    concurrency makes this a single-writer operation: if a second
+    controller (a standby router that wrongly believes it leads, a
+    human ``kubectl edit``) raced the window, the PUT fails with
+    ``ConflictError`` instead of silently clobbering, and the caller's
+    decision record says so. The operator's watch sees the MODIFIED
+    event and reconciles the rendered Deployment's ``replicas`` — the
+    same code path every other spec change takes, so scale-downs drain
+    through the chart's preStop hook like any rollout.
+
+    Returns the patched manifest. Raises ``KeyError`` when the CR or
+    the release is missing (a scale target that does not exist is a
+    config error, not a quiet no-op)."""
+    from .types import API_VERSION, KIND
+
+    key = (API_VERSION, KIND, namespace, pipeline)
+    obj = kube.get(key)
+    if obj is None:
+        raise KeyError(f"HelmPipeline {namespace}/{pipeline} not found")
+    # Work on a copy: fakes (InMemoryKube) hand back their stored
+    # object, and a ConflictError must leave the store unmodified.
+    obj = json.loads(json.dumps(obj))
+    entries = (obj.get("spec") or {}).get("pipeline") or []
+    for entry in entries:
+        pkg = entry.get("helmPackage", entry)
+        name = pkg.get("releaseName") or pkg.get("chartName")
+        if name != release:
+            continue
+        values = pkg.setdefault("chartValues", {}) or {}
+        pkg["chartValues"] = values
+        node = values
+        for part in values_path[:-1]:
+            node = node.setdefault(part, {})
+        node[values_path[-1]] = int(replicas)
+        # Keep the observed resourceVersion: KubeInterface.apply treats
+        # a caller-supplied version as "check it" (ConflictError on a
+        # race) instead of adopting whatever is live at write time.
+        kube.apply(obj)
+        return obj
+    raise KeyError(
+        f"HelmPipeline {namespace}/{pipeline} has no package with "
+        f"release {release!r}")

@@ -193,7 +193,8 @@ def engine_stat_keys() -> tuple[str, ...]:
     truth tools/check_metrics_docs.py checks the docs against."""
     from .prefix_cache import CacheStats
     return (tuple(_STATS_TEMPLATE)
-            + ("dispatch_queue_depth", "sched_prefill_share",
+            + ("dispatch_queue_depth", "queue_waiting",
+               "sched_prefill_share",
                "spec_acceptance_rate", "spec_tokens_per_step",
                "sched_cost_drift_ratio",
                "kv_tier_host_pages", "kv_restore_hit_rate")
@@ -1225,6 +1226,13 @@ class Engine:
             # but not yet harvested. >0 during steady decode means the
             # device never goes idle waiting for the host.
             out["dispatch_queue_depth"] = self._inflight_rounds
+        # Queued WORK awaiting admission: intake + scheduler backlog —
+        # the leading congestion signal the router's load score and the
+        # autoscaler's queue trigger read (dispatch_queue_depth alone
+        # saturates at dispatch_depth and reads "2" on a replica
+        # drowning in queued prefills). len()/qsize() are GIL-atomic;
+        # this is a snapshot, not an admission decision.
+        out["queue_waiting"] = len(self._backlog) + self._pending.qsize()
         # Scheduler mix: what share of the budgeted work was prefill.
         sched_total = out["sched_prefill_tokens"] + out["sched_decode_tokens"]
         out["sched_prefill_share"] = (

@@ -124,6 +124,16 @@ def main(argv: Optional[list[str]] = None) -> int:
     serve_p.add_argument("--port", type=int, default=8080)
     serve_p.add_argument("--policy", default=None,
                          choices=("affinity", "round_robin"))
+    serve_p.add_argument("--autoscale", action="store_true",
+                         help="attach the SLO-driven autoscale "
+                              "controller (docs/autoscaling.md; knobs "
+                              "via ROUTER_AUTOSCALE_* env) — same as "
+                              "ROUTER_AUTOSCALE=1")
+    serve_p.add_argument("--min-replicas", type=int, default=None,
+                         help="autoscale floor (ROUTER_AUTOSCALE_MIN)")
+    serve_p.add_argument("--max-replicas", type=int, default=None,
+                         help="autoscale ceiling (ROUTER_AUTOSCALE_MAX; "
+                              "default: the --replicas count)")
 
     drain_p = sub.add_parser("drain", help="drain one replica (preStop)")
     drain_p.add_argument("--url", required=True)
@@ -157,7 +167,24 @@ def main(argv: Optional[list[str]] = None) -> int:
         print("serve: --replicas (or ROUTER_REPLICAS) is required",
               file=sys.stderr)
         return 2
-    app = create_router_app(replicas, policy=args.policy)
+    autoscale = None
+    if args.autoscale or os.environ.get("ROUTER_AUTOSCALE", "") \
+            not in ("", "0", "false", "off"):
+        from .autoscale import AutoscaleController, AutoscalePolicy
+
+        def autoscale_factory(router):
+            policy = AutoscalePolicy.from_env(
+                min_replicas=args.min_replicas,
+                max_replicas=(args.max_replicas
+                              if args.max_replicas is not None
+                              else (None if os.environ.get(
+                                  "ROUTER_AUTOSCALE_MAX")
+                                  else len(replicas))))
+            return AutoscaleController(router, policy=policy,
+                                       surge=router.surge)
+        autoscale = autoscale_factory
+    app = create_router_app(replicas, policy=args.policy,
+                            autoscale_factory=autoscale)
     web.run_app(app, host=args.host, port=args.port)
     return 0
 

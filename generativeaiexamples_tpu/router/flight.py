@@ -137,6 +137,20 @@ class SloWindow:
                 ttft_ms / 1e3)
         return attained
 
+    def forget(self, replica: str) -> int:
+        """Drop every outcome row attributed to ``replica`` — the
+        membership-churn hook: a removed (or re-added) replica's window
+        must not poison the fresh pod's attainment, and fleet totals
+        must stop counting a member that no longer exists. Returns the
+        number of rows dropped."""
+        with self._lock:
+            kept = [r for r in self._ring if r[1] != replica]
+            dropped = len(self._ring) - len(kept)
+            if dropped:
+                self._ring.clear()
+                self._ring.extend(kept)
+        return dropped
+
     # ------------------------------------------------------------ readers
 
     def _live_rows(self) -> list[tuple]:

@@ -53,8 +53,10 @@ ROUTER_METRICS: dict[str, tuple[str, tuple[str, ...], str]] = {
         "(docs/kv-tiering.md)"),
     "router_replica_queue_depth": (
         "gauge", ("replica",),
-        "per-replica engine dispatch queue depth from the last "
-        "heartbeat"),
+        "per-replica queued engine work from the last heartbeat: "
+        "admission intake + scheduler backlog + in-flight device "
+        "rounds — the congestion signal placement penalizes and the "
+        "autoscaler's queue trigger reads"),
     "router_replica_in_flight": (
         "gauge", ("replica",),
         "per-replica in-flight /generate streams from the last "
@@ -118,6 +120,24 @@ ROUTER_METRICS: dict[str, tuple[str, tuple[str, ...], str]] = {
         "from the heartbeat) minus observed round-telemetry throughput "
         "— the number an SLO-driven autoscaler scales on "
         "(GET /debug/fleet carries the per-replica breakdown)"),
+    "router_autoscale_target_replicas": (
+        "gauge", (),
+        "the autoscale controller's current replica target — what the "
+        "last control cycle decided the fleet should be, whether or not "
+        "an executor has finished converging it (GET /debug/autoscale "
+        "carries the decision ring with full evidence)"),
+    "router_autoscale_decisions_total": (
+        "counter", ("action",),
+        "autoscale control cycles by decided action: scale_up, "
+        "scale_down, hold, surge_on, surge_off, blocked (cooldown / "
+        "not leader / no executor / no drain candidate) — "
+        "docs/autoscaling.md has the control law"),
+    "router_surge_queue_depth": (
+        "gauge", (),
+        "requests currently waiting in the router's surge-admission "
+        "queue — nonzero only while the fleet is at max_replicas and "
+        "overloaded; sustained depth near ROUTER_SURGE_QUEUE_CAP means "
+        "the fleet ceiling itself is too low"),
 }
 
 

@@ -58,8 +58,10 @@ estimate into the one snapshot an autoscaler or operator reads.
 from __future__ import annotations
 
 import asyncio
+import functools
 import json
 import os
+import random
 import time
 from typing import Optional, Sequence
 
@@ -69,6 +71,7 @@ from aiohttp import web
 from ..obs import flight as obs_flight
 from ..utils import faults
 from ..utils.logging import get_logger
+from . import autoscale as router_autoscale
 from . import fleet as router_fleet
 from . import metrics as router_metrics
 from .flight import RouterFlightRecorder
@@ -151,7 +154,9 @@ class FleetRouter:
                  forward_timeout_s: float = 300.0,
                  kv_transfer: bool = False,
                  kv_transfer_min_blocks: int = 2,
-                 flight: Optional[RouterFlightRecorder] = None):
+                 heartbeat_jitter: float = 0.2,
+                 flight: Optional[RouterFlightRecorder] = None,
+                 surge: Optional[router_autoscale.SurgeGate] = None):
         self.table = table
         # Router flight recorder + rolling SLO window (router/flight.py):
         # per-router instance, so the fleet bench's per-arm routers and
@@ -170,26 +175,44 @@ class FleetRouter:
         # the replicas; the hint is ignored where tiering is off.
         self.kv_transfer = bool(kv_transfer)
         self.kv_transfer_min_blocks = max(1, int(kv_transfer_min_blocks))
+        # Sweep desynchronization: each heartbeat cycle sleeps
+        # heartbeat_s * U(1-j, 1+j), so N routers polling one fleet (or
+        # one router's restarts) never phase-lock their probe bursts.
+        self.heartbeat_jitter = min(0.9, max(0.0, float(heartbeat_jitter)))
+        # Surge admission (router/autoscale.py): counts in-flight
+        # forwards always; gates only while the autoscaler (or an
+        # operator) flips it active.
+        self.surge = surge or router_autoscale.SurgeGate()
+        #: The attached AutoscaleController, if any (create_router_app).
+        self.autoscale: Optional[router_autoscale.AutoscaleController] = \
+            None
         self._session: Optional[aiohttp.ClientSession] = None
         self._hb_task: Optional[asyncio.Task] = None
+        self._as_task: Optional[asyncio.Task] = None
         self._fleet: Optional[dict] = None   # last refresh_fleet() result
 
     # ---------------------------------------------------------- lifecycle
 
-    async def start(self, run_heartbeat: bool = True) -> None:
+    async def start(self, run_heartbeat: bool = True,
+                    run_autoscale: bool = True) -> None:
         if self._session is None:
             self._session = aiohttp.ClientSession()
         if run_heartbeat and self._hb_task is None:
             self._hb_task = asyncio.create_task(self._heartbeat_loop())
+        if run_autoscale and self.autoscale is not None \
+                and self._as_task is None:
+            self._as_task = asyncio.create_task(self.autoscale.run())
 
     async def stop(self) -> None:
-        if self._hb_task is not None:
-            self._hb_task.cancel()
-            try:
-                await self._hb_task
-            except (asyncio.CancelledError, Exception):  # noqa: BLE001
-                pass
-            self._hb_task = None
+        for attr in ("_hb_task", "_as_task"):
+            task = getattr(self, attr)
+            if task is not None:
+                task.cancel()
+                try:
+                    await task
+                except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                    pass
+                setattr(self, attr, None)
         if self._session is not None:
             await self._session.close()
             self._session = None
@@ -209,18 +232,42 @@ class FleetRouter:
                 raise
             except Exception:  # noqa: BLE001 — the loop must survive
                 logger.exception("router heartbeat cycle failed")
-            await asyncio.sleep(self.heartbeat_s)
+            await asyncio.sleep(self._next_heartbeat_delay())
+
+    def _next_heartbeat_delay(self) -> float:
+        """Jittered sweep period: ``heartbeat_s * U(1-j, 1+j)``."""
+        j = self.heartbeat_jitter
+        return self.heartbeat_s * random.uniform(1.0 - j, 1.0 + j)
 
     async def heartbeat_once(self) -> None:
-        """Probe every replica's /health concurrently; apply results."""
+        """Probe every replica's /health concurrently. Each probe is
+        bounded by its OWN timeout (the HTTP client timeout plus slack
+        for injected stalls), so one wedged replica costs the sweep at
+        most that bound — its siblings' health lands the moment their
+        probes return, never behind the straggler's."""
         reps = self.table.replicas()
         if not reps:
             return
-        await asyncio.gather(*(self._probe(r) for r in reps))
+        await asyncio.gather(*(self._probe_bounded(r) for r in reps))
+
+    async def _probe_bounded(self, rep) -> None:
+        try:
+            await asyncio.wait_for(self._probe(rep),
+                                   timeout=self.heartbeat_timeout_s + 1.0)
+        except asyncio.TimeoutError:
+            logger.debug("heartbeat to %s exceeded the poll bound",
+                         rep.name)
+            self.table.update_health(rep.name, ok=False, ready=False)
 
     async def _probe(self, rep) -> None:
         try:
-            faults.inject("replica.heartbeat", tag=rep.name)
+            # Injected faults run OFF the event loop: a delay/hang plan
+            # on one replica's heartbeat must stall that one probe's
+            # thread, not the loop every sibling's probe shares.
+            if faults.active():
+                await asyncio.get_running_loop().run_in_executor(
+                    None, functools.partial(
+                        faults.inject, "replica.heartbeat", tag=rep.name))
             assert self._session is not None
             async with self._session.get(
                     rep.url + "/health",
@@ -237,6 +284,64 @@ class FleetRouter:
         except Exception as exc:  # noqa: BLE001 — any probe failure
             logger.debug("heartbeat to %s failed: %s", rep.name, exc)
             self.table.update_health(rep.name, ok=False, ready=False)
+
+    # --------------------------------------------------------- membership
+
+    async def remove_replica(self, name: str, *, drain: bool = True,
+                             wait_s: float = 30.0,
+                             poll_s: float = 0.1) -> bool:
+        """Remove a replica from the table — the scale-down/rollout
+        path. With ``drain`` (the default), placement stops IMMEDIATELY
+        (the table marks it draining), the replica's own admission is
+        closed via ``POST /control/drain``, and the removal waits up to
+        ``wait_s`` for its in-flight streams to finish — a streaming
+        replica is never dropped mid-token. The replica's SLO-window
+        rows are forgotten with it, so a later re-add under the same
+        name starts with clean attainment (and a fresh sketch + breaker,
+        via ``table.add``'s reset semantics)."""
+        rep = self.table.get(name)
+        if rep is None:
+            return False
+        if drain:
+            self.table.mark_draining(name)
+            assert self._session is not None
+            try:
+                async with self._session.post(
+                        rep.url + "/control/drain",
+                        timeout=aiohttp.ClientTimeout(
+                            total=self.heartbeat_timeout_s)) as resp:
+                    await resp.read()
+            except Exception as exc:  # noqa: BLE001 — dead replica: done
+                logger.info("drain of %s unreachable (%s); removing",
+                            name, exc)
+            else:
+                deadline = time.monotonic() + max(0.0, float(wait_s))
+                while time.monotonic() < deadline:
+                    in_flight = await self._drain_in_flight(rep)
+                    if in_flight is None or in_flight <= 0:
+                        break
+                    await asyncio.sleep(poll_s)
+                else:
+                    logger.warning(
+                        "drain of %s still has streams in flight after "
+                        "%.1fs budget; removing anyway", name, wait_s)
+        self.table.remove(name)
+        self.flight.slo.forget(name)
+        return True
+
+    async def _drain_in_flight(self, rep) -> Optional[int]:
+        """The draining replica's in-flight stream count from /health
+        (a drained replica answers 503 — the BODY is the signal)."""
+        try:
+            assert self._session is not None
+            async with self._session.get(
+                    rep.url + "/health",
+                    timeout=aiohttp.ClientTimeout(
+                        total=self.heartbeat_timeout_s)) as resp:
+                body = await resp.json()
+            return int((body.get("load") or {}).get("in_flight", 0))
+        except Exception:  # noqa: BLE001 — unreachable: nothing to wait on
+            return None
 
     # -------------------------------------------------------------- fleet
 
@@ -258,20 +363,47 @@ class FleetRouter:
     # ------------------------------------------------------------ forward
 
     async def forward(self, request: web.Request) -> web.StreamResponse:
-        raw = await request.read()
-        try:
-            body = json.loads(raw) if raw else {}
-        except (ValueError, UnicodeDecodeError):
-            body = {}
-        blocks = self.table.affinity_blocks(
-            affinity_text(request.path, body if isinstance(body, dict)
-                          else {}))
         # Router flight timeline (router/flight.py): keyed by the SAME
         # X-Request-ID forwarded below, so the router's record joins the
         # replica's /debug/requests timeline and the engine's round
-        # grants by one ID.
+        # grants by one ID. Begun BEFORE surge admission so a surge 429
+        # still has a timeline and an SLO-window row.
         tl = self.flight.begin_request(request.headers, request.path)
+        # Surge admission (docs/autoscaling.md): while the autoscaler
+        # holds the gate active (fleet at max and overloaded), a bounded
+        # wait queue fronts placement and the rejections are honest
+        # backpressure — Retry-After from the measured queue-wait
+        # estimate, fast 429 for deadlines the queue would eat whole.
         try:
+            ticket, rejection = await self.surge.enter(
+                deadline_ms=tl.meta.get("deadline_ms"))
+        except asyncio.CancelledError:
+            # Caller hung up while QUEUED in the surge gate (the
+            # overload case exactly): the gate cleaned its own slot up;
+            # the timeline must still retire or the in-flight map leaks
+            # one entry per impatient caller.
+            self.flight.complete_request(tl, outcome="disconnect")
+            raise
+        except BaseException:
+            self.flight.complete_request(tl, outcome="error")
+            raise
+        if rejection is not None:
+            err_type, est_wait_ms = rejection
+            self.flight.complete_request(tl, outcome="shed", status=429)
+            return _error_response(
+                429, err_type,
+                f"fleet is at capacity ({err_type}); estimated queue "
+                f"wait {est_wait_ms:.0f} ms", tl.request_id,
+                retry_after_s=est_wait_ms / 1e3)
+        try:
+            raw = await request.read()
+            try:
+                body = json.loads(raw) if raw else {}
+            except (ValueError, UnicodeDecodeError):
+                body = {}
+            blocks = self.table.affinity_blocks(
+                affinity_text(request.path, body if isinstance(body, dict)
+                              else {}))
             return await self._forward_attempts(request, raw, blocks, tl)
         except asyncio.CancelledError:
             # Caller hung up while we were placing/connecting/streaming:
@@ -282,6 +414,8 @@ class FleetRouter:
         except BaseException:
             self.flight.complete_request(tl, outcome="error")
             raise
+        finally:
+            self.surge.exit(ticket)
 
     async def _forward_attempts(self, request: web.Request, raw: bytes,
                                 blocks: Sequence[bytes],
@@ -555,15 +689,26 @@ def create_router_app(replicas: Sequence[tuple[str, str]] = (), *,
                       heartbeat_s: Optional[float] = None,
                       retry_attempts: Optional[int] = None,
                       kv_transfer: Optional[bool] = None,
-                      run_heartbeat: bool = True) -> web.Application:
+                      run_heartbeat: bool = True,
+                      autoscale: Optional[
+                          "router_autoscale.AutoscaleController"] = None,
+                      autoscale_factory: Optional[callable] = None,
+                      run_autoscale: bool = True) -> web.Application:
     """Build the router app. ``replicas`` is (name, url) pairs; pass a
     pre-built ``table`` instead to control scoring knobs. Env defaults:
-    ``ROUTER_POLICY``, ``ROUTER_HEARTBEAT_S``, ``ROUTER_RETRY_ATTEMPTS``,
+    ``ROUTER_POLICY``, ``ROUTER_HEARTBEAT_S`` /
+    ``ROUTER_HEARTBEAT_JITTER``, ``ROUTER_RETRY_ATTEMPTS``,
     ``ROUTER_AFFINITY_BLOCK_BYTES`` / ``ROUTER_AFFINITY_HEAD_BYTES`` /
     ``ROUTER_SKETCH_CAP``, ``ROUTER_BREAKER_FAILURES`` /
     ``ROUTER_BREAKER_COOLDOWN_S``, ``ROUTER_CONNECT_TIMEOUT_S`` /
     ``ROUTER_FORWARD_TIMEOUT_S``, ``ROUTER_KV_TRANSFER`` /
-    ``ROUTER_KV_TRANSFER_MIN_BLOCKS`` (docs/router.md)."""
+    ``ROUTER_KV_TRANSFER_MIN_BLOCKS`` (docs/router.md), and the
+    autoscaler/surge knobs (``ROUTER_AUTOSCALE*`` / ``ROUTER_SURGE_*``,
+    docs/autoscaling.md). ``autoscale_factory`` builds a controller
+    bound to the finished router (``factory(router) -> controller``);
+    ``autoscale`` attaches one already built; ``ROUTER_AUTOSCALE=1``
+    builds the env-configured default (dry-run decisions + surge
+    admission unless an executor is configured)."""
     if table is None:
         table = ReplicaTable(
             policy=policy or os.environ.get("ROUTER_POLICY", "affinity"),
@@ -589,7 +734,22 @@ def create_router_app(replicas: Sequence[tuple[str, str]] = (), *,
                      else os.environ.get("ROUTER_KV_TRANSFER", "")
                      not in ("", "0", "false", "off")),
         kv_transfer_min_blocks=int(
-            _env_float("ROUTER_KV_TRANSFER_MIN_BLOCKS", 2)))
+            _env_float("ROUTER_KV_TRANSFER_MIN_BLOCKS", 2)),
+        heartbeat_jitter=_env_float("ROUTER_HEARTBEAT_JITTER", 0.2))
+
+    if autoscale is None and autoscale_factory is not None:
+        autoscale = autoscale_factory(router)
+    if autoscale is None and os.environ.get(
+            "ROUTER_AUTOSCALE", "") not in ("", "0", "false", "off"):
+        autoscale = router_autoscale.AutoscaleController(
+            router,
+            policy=router_autoscale.AutoscalePolicy.from_env(
+                max_replicas=max(1, len(table.replicas()))
+                if not os.environ.get("ROUTER_AUTOSCALE_MAX") else None),
+            executor=None, surge=router.surge)
+    if autoscale is not None:
+        router.autoscale = autoscale
+        router.surge = autoscale.surge
 
     app = web.Application(client_max_size=100 * 1024 ** 2)
     app[ROUTER] = router
@@ -629,10 +789,16 @@ def create_router_app(replicas: Sequence[tuple[str, str]] = (), *,
                                   "policy": table.policy})
 
     async def control_replicas(request: web.Request) -> web.Response:
-        """Runtime table edits — the rollout story's API:
+        """Runtime table edits — dynamic membership, the rollout AND
+        autoscale story's API:
         ``{"op": "add", "name": "r2", "url": "http://..."}`` /
-        ``{"op": "remove", "name": "r2"}``. New replicas receive traffic
-        after their first successful heartbeat."""
+        ``{"op": "remove", "name": "r2", "drain": true,
+        "wait_s": 30}``. Adds probe immediately (traffic flows without
+        waiting a heartbeat); removes default to DRAIN-ON-REMOVE —
+        placement stops at once, the replica's admission closes, and
+        the call returns after its in-flight streams finish (or the
+        wait budget expires). ``"drain": false`` is the hard-remove
+        escape hatch for an already-dead replica."""
         body = await request.json()
         op, name = body.get("op"), body.get("name", "")
         if op == "add":
@@ -640,17 +806,54 @@ def create_router_app(replicas: Sequence[tuple[str, str]] = (), *,
                 raise web.HTTPUnprocessableEntity(
                     text="add needs 'name' and 'url'")
             rep = table.add(name, body["url"])
+            # A re-add under a known name is a NEW pod: its window rows
+            # (like its sketch and breaker, reset by table.add) must not
+            # carry the old pod's history.
+            router.flight.slo.forget(name)
             # Probe now: an added replica that is already up starts
             # taking traffic without waiting a full heartbeat period.
             await router._probe(rep)
             return web.json_response({"status": "added",
                                       "replica": rep.snapshot()})
         if op == "remove":
-            found = table.remove(name)
+            drain = bool(body.get("drain", True))
+            wait_s = float(body.get("wait_s", 30.0))
+            found = await router.remove_replica(name, drain=drain,
+                                                wait_s=wait_s)
             return web.json_response(
-                {"status": "removed" if found else "absent"},
+                {"status": ("removed" if found else "absent"),
+                 "drained": bool(found and drain)},
                 status=200 if found else 404)
         raise web.HTTPUnprocessableEntity(text="op must be add|remove")
+
+    async def debug_autoscale(request: web.Request) -> web.Response:
+        """The autoscaler's decision ring + surge state
+        (docs/autoscaling.md; schema-pinned by
+        ``router.autoscale.validate_autoscale_snapshot``)."""
+        if router.autoscale is None:
+            return web.json_response(
+                {"enabled": False, "surge": router.surge.snapshot()})
+        try:
+            limit = int(request.query.get("limit", "50") or 50)
+        except ValueError:
+            raise web.HTTPBadRequest(text="limit must be an integer")
+        return web.json_response(router.autoscale.snapshot(limit=limit))
+
+    async def control_autoscale(request: web.Request) -> web.Response:
+        """Ops/test surface: ``{"op": "tick"}`` runs one control cycle
+        NOW and returns its decision record; ``{"op": "surge",
+        "active": bool}`` overrides the surge gate by hand (incident
+        control when the autoscaler is not attached)."""
+        body = await request.json()
+        op = body.get("op")
+        if op == "tick":
+            if router.autoscale is None:
+                raise web.HTTPConflict(text="no autoscaler attached")
+            return web.json_response(await router.autoscale.tick())
+        if op == "surge":
+            router.surge.set_active(bool(body.get("active", False)))
+            return web.json_response(router.surge.snapshot())
+        raise web.HTTPUnprocessableEntity(text="op must be tick|surge")
 
     async def control_heartbeat(request: web.Request) -> web.Response:
         """Force one heartbeat cycle now (ops/tests)."""
@@ -665,14 +868,17 @@ def create_router_app(replicas: Sequence[tuple[str, str]] = (), *,
     app.router.add_get("/metrics", metrics_endpoint)
     app.router.add_get("/debug/requests", debug_requests)
     app.router.add_get("/debug/fleet", debug_fleet)
+    app.router.add_get("/debug/autoscale", debug_autoscale)
     app.router.add_get("/router/replicas", list_replicas)
     app.router.add_post("/control/replicas", control_replicas)
     app.router.add_post("/control/heartbeat", control_heartbeat)
+    app.router.add_post("/control/autoscale", control_autoscale)
     for path in FORWARD_PATHS:
         app.router.add_post(path, forward)
 
     async def on_startup(app_: web.Application) -> None:
-        await router.start(run_heartbeat=run_heartbeat)
+        await router.start(run_heartbeat=run_heartbeat,
+                           run_autoscale=run_autoscale)
 
     async def on_cleanup(app_: web.Application) -> None:
         await router.stop()

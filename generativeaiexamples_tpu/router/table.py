@@ -383,6 +383,24 @@ class ReplicaTable:
                 "router_heartbeat_age_seconds", rep.name).set(
                 round(age, 3))
 
+    def scale_down_candidate(self,
+                             exclude: Sequence[str] = ()) -> Optional[str]:
+        """The replica a scale-down should drain first: the PLACEABLE
+        one with the least in-flight work (fewest edge streams, then
+        shallowest queue, then fewest lifetime placements — the
+        cheapest drain and the smallest affinity-sketch loss). Draining
+        or dead replicas are never proposed (they are already leaving
+        or already gone); None when no placeable replica remains."""
+        with self._lock:
+            candidates = [r for r in self._replicas.values()
+                          if r.name not in exclude and r.placeable()]
+            if not candidates:
+                return None
+            return min(candidates, key=lambda r: (
+                int(r.load.get("in_flight", 0)),
+                int(r.load.get("queue_depth", 0)),
+                r.placements, r.name)).name
+
     def mark_unreachable(self, name: str) -> None:
         with self._lock:
             rep = self._replicas.get(name)

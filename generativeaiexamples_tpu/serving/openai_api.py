@@ -150,9 +150,13 @@ def add_openai_routes(app: web.Application, engine, model_name: str,
                                                  deadline_t=deadline_t))
         except SchedulerFullError as exc:
             # Overload is a 429 with a retry hint, not a 503: the engine
-            # is alive, its admission queue is full.
+            # is alive, its admission queue is full. Retry-After from the
+            # flight recorder's measured queue-wait estimate — retries
+            # space to the queue's actual drain time, not a constant.
+            _, wait_ms = obs_flight.RECORDER.recent_stage_ms(
+                "engine_admit_pickup")
             return _openai_error(429, "rate_limit_error", str(exc),
-                                 retry_after_s=1.0)
+                                 retry_after_s=max(1.0, wait_ms / 1e3))
         except Exception as exc:  # noqa: BLE001
             return _openai_error(503, "service_unavailable", str(exc))
         # The response id must BE the timeline key: a duplicate

@@ -29,6 +29,10 @@ point                   where it fires
                         (engine/kv_tier.py fetch_blocks, on the
                         requesting side; a hang is bounded by the
                         transfer timeout and the request places cold)
+``autoscale.execute``   the autoscale controller's executor call
+                        (router/autoscale.py tick — a failure lands in
+                        the decision record's ``executor.error`` and the
+                        controller retries next cycle)
 ======================  ====================================================
 
 A **fault plan** maps points to behaviors::
@@ -77,7 +81,7 @@ from .errors import FrameworkError
 POINTS = frozenset({
     "retrieval.search", "embed", "engine.dispatch", "engine.harvest",
     "http.connect", "router.forward", "replica.heartbeat",
-    "kv.offload", "kv.restore", "kv.transfer",
+    "kv.offload", "kv.restore", "kv.transfer", "autoscale.execute",
 })
 
 #: Upper bound on a ``hang`` fault, seconds (env-overridable).

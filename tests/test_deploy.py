@@ -467,3 +467,72 @@ def test_sample_cr_parses_and_round_trips():
     assert pipe.packages[0].values["modelServer"]["tensorParallelism"] == 8
     again = HelmPipeline.from_manifest(pipe.to_manifest())
     assert again == pipe
+
+
+# ------------------------------------------------- autoscale scale target
+
+
+def test_set_scale_target_patches_chart_values_and_reconciles():
+    """ISSUE 13: the autoscaler's k8s write path. set_scale_target
+    patches the named package's chartValues replica count on the live
+    CR; a subsequent reconcile renders the Deployment at the new count
+    — the same path every other spec change takes."""
+    from generativeaiexamples_tpu.deploy.operator import set_scale_target
+
+    kube = InMemoryKube()
+    pipe = _pipeline(values={"chainServer": {"enabled": True}})
+    kube.apply(pipe.to_manifest())
+    patched = set_scale_target(
+        kube, namespace="ns", pipeline="pipe", release="rag",
+        replicas=5, values_path=("chainServer", "replicas"))
+    pkg = patched["spec"]["pipeline"][0]["helmPackage"]
+    assert pkg["chartValues"]["chainServer"]["replicas"] == 5
+    # the stored CR carries the patch...
+    stored = kube.get((API_VERSION, KIND, "ns", "pipe"))
+    assert stored["spec"]["pipeline"][0]["helmPackage"][
+        "chartValues"]["chainServer"]["replicas"] == 5
+    # ... and reconciling it rolls the Deployment to 5 replicas.
+    op = PipelineOperator(kube)
+    op.reconcile(HelmPipeline.from_manifest(stored))
+    dep = next(o for key, o in kube.objects.items()
+               if key[1] == "Deployment"
+               and "chain-server" in o["metadata"]["name"])
+    assert dep["spec"]["replicas"] == 5
+
+
+def test_set_scale_target_single_writer_conflict_and_missing():
+    """Optimistic concurrency: the PUT carries the resourceVersion the
+    read observed, so a raced writer surfaces as ConflictError (the
+    decision record's executor.error) instead of clobbering — and the
+    store keeps the OTHER writer's value."""
+    from generativeaiexamples_tpu.deploy.operator import set_scale_target
+
+    kube = InMemoryKube()
+    pipe = _pipeline()
+    kube.apply(pipe.to_manifest())
+    key = (API_VERSION, KIND, "ns", "pipe")
+    stale = json.loads(json.dumps(kube.get(key)))
+
+    # A second writer lands between our read and our write.
+    other = json.loads(json.dumps(kube.get(key)))
+    other["spec"]["pipeline"][0]["helmPackage"]["chartValues"] = {
+        "chainServer": {"replicas": 9}}
+    kube.apply(other)
+
+    real_get = kube.get
+    kube.get = lambda k: stale if k == key else real_get(k)
+    with pytest.raises(ConflictError):
+        set_scale_target(kube, namespace="ns", pipeline="pipe",
+                         release="rag", replicas=2,
+                         values_path=("chainServer", "replicas"))
+    kube.get = real_get
+    kept = kube.get(key)["spec"]["pipeline"][0]["helmPackage"]
+    assert kept["chartValues"]["chainServer"]["replicas"] == 9
+
+    # Missing CR / unknown release are loud config errors, not no-ops.
+    with pytest.raises(KeyError):
+        set_scale_target(kube, namespace="ns", pipeline="ghost",
+                         release="rag", replicas=2)
+    with pytest.raises(KeyError):
+        set_scale_target(kube, namespace="ns", pipeline="pipe",
+                         release="ghost-release", replicas=2)

@@ -75,6 +75,33 @@ def test_extract_fleet_policy_metrics_direction_aware():
                for n in notes)
 
 
+def test_extract_autoscale_policy_metrics_direction_aware():
+    """Autoscale arms contribute per-policy headline gates (ISSUE 13):
+    attainment is gated UP and replica_minutes DOWN — an attainment
+    'win' bought by quietly spending a fatter fleet is a regression on
+    the bill, and the gate must say so."""
+    result = _result(autoscale={"policies": [
+        {"policy": "autoscaled", "slo_attainment": 0.97,
+         "replica_minutes": 0.42, "ttft_p50_ms": 90.0},
+        {"policy": "static", "slo_attainment": 0.81,
+         "replica_minutes": 0.42, "ttft_p50_ms": 150.0},
+    ]})
+    m = extract_metrics(result)
+    assert m["autoscale.slo_attainment@autoscaled"] == (0.97, "higher")
+    assert m["autoscale.replica_minutes@autoscaled"] == (0.42, "lower")
+    assert m["autoscale.slo_attainment@static"] == (0.81, "higher")
+    worse = extract_metrics(_result(autoscale={"policies": [
+        {"policy": "autoscaled", "slo_attainment": 0.80,
+         "replica_minutes": 0.80, "ttft_p50_ms": 90.0},
+    ]}))
+    regressions, _ = compare(m, worse)
+    assert any("autoscale.slo_attainment@autoscaled" in r
+               for r in regressions)
+    # MORE replica-minutes is the wrong direction
+    assert any("autoscale.replica_minutes@autoscaled" in r
+               for r in regressions)
+
+
 def test_extract_tolerates_missing_sections():
     m = extract_metrics({"decode_tokens_per_sec": 100.0, "chat": {}})
     assert set(m) == {"decode_tokens_per_sec"}

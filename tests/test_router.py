@@ -895,3 +895,103 @@ def test_acceptance_affinity_fleet_warm_ttft_and_failover(fleet_engines):
                     s.kill()
                 except Exception:  # noqa: BLE001 — teardown best-effort
                     pass
+
+
+# ---------------------------------------------- membership churn (ISSUE 13)
+# Defined LAST on purpose: it runs after the timing-sensitive ISSUE-7
+# acceptance test above, whose warm-TTFT comparison is calibrated to the
+# suite's load at that point.
+
+
+def test_replica_churn_under_load_resets_state_without_poisoning():
+    """ISSUE 13 satellite: add/remove/re-add a replica while sessions
+    stream through the fleet. The removed member's affinity sketch,
+    breaker, SLO-window rows, and shed baseline are dropped with it;
+    the re-added one starts clean and placement keeps working
+    throughout — no 5xx, no placement onto the absent member."""
+    from generativeaiexamples_tpu.router.server import ROUTER
+
+    apps = [create_app(EchoExample()), create_app(EchoExample())]
+
+    async def fn():
+        servers = [TestServer(a) for a in apps]
+        for s in servers:
+            await s.start_server()
+        urls = [f"http://127.0.0.1:{s.port}" for s in servers]
+        router_app = create_router_app(
+            [("r0", urls[0]), ("r1", urls[1])], policy="affinity",
+            heartbeat_s=30, run_heartbeat=False)
+        client = TestClient(TestServer(router_app))
+        await client.start_server()
+        router = router_app[ROUTER]
+        table = router.table
+        stop = asyncio.Event()
+        statuses: list = []
+
+        async def traffic(worker: int):
+            i = 0
+            while not stop.is_set():
+                resp = await client.post(
+                    "/generate",
+                    json={"question": f"churn w{worker} q{i}",
+                          "context": f"churn session {worker} "
+                                     + "z" * 180,
+                          "use_knowledge_base": False})
+                statuses.append(resp.status)
+                body = await resp.read()
+                if resp.status == 200:
+                    assert b"[error]" not in body
+                i += 1
+                await asyncio.sleep(0.01)
+
+        workers = [asyncio.ensure_future(traffic(w)) for w in range(3)]
+        try:
+            await asyncio.sleep(0.2)   # sessions teach r0/r1 sketches
+            # Dirty r0's state so the reset is observable: window rows,
+            # sketch entries, a tripped breaker, a shed baseline.
+            rep = table.get("r0")
+            assert len(rep.sketch) > 0
+            rep.breaker.record_failure()
+            router.flight.slo.record(replica="r0", outcome="error")
+            table.update_health("r0", ok=True, body={
+                "load": {"rejected_total": 500}})
+            # remove (drain) while traffic flows...
+            resp = await client.post(
+                "/control/replicas",
+                json={"op": "remove", "name": "r0", "wait_s": 10})
+            assert resp.status == 200
+            assert table.get("r0") is None
+            await asyncio.sleep(0.2)   # every request lands on r1
+            # ... and re-add (the "restarted pod" reopens admission
+            # first — drain-on-remove closed it): state must be CLEAN,
+            # not inherited.
+            async with aiohttp.ClientSession() as s:
+                await (await s.post(
+                    f"{urls[0]}/control/undrain")).read()
+            resp = await client.post(
+                "/control/replicas",
+                json={"op": "add", "name": "r0", "url": urls[0]})
+            assert resp.status == 200
+            fresh = table.get("r0")
+            assert len(fresh.sketch) == 0
+            assert fresh.breaker.state == "closed"
+            assert fresh.placements == 0
+            assert fresh.recent_rejects == 0.0
+            window = router.flight.slo.snapshot(["r0"])["r0"]
+            assert window["requests"] == 0     # forgotten on remove
+            # shed baseline restarts: a huge lifetime counter on the
+            # next heartbeat is baseline, not recent shed
+            table.update_health("r0", ok=True, body={
+                "load": {"rejected_total": 10_000}})
+            assert table.get("r0").recent_rejects == 0.0
+            await asyncio.sleep(0.2)   # traffic flows over both again
+        finally:
+            stop.set()
+            await asyncio.gather(*workers)
+            await client.close()
+            for s in servers:
+                await s.close()
+        assert statuses and set(statuses) == {200}
+        assert table.get("r0").placeable()
+
+    _run(fn())

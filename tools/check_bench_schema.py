@@ -77,7 +77,7 @@ def validate_result(result: dict, schema: dict | None = None) -> None:
     _check_types("result", result, schema["top_level"], errors)
     for section in ("engine_pipeline", "engine_rounds", "e2e_ttft_dist_ms",
                     "chat", "openloop", "fleet", "capacity",
-                    "kv_pressure"):
+                    "kv_pressure", "autoscale"):
         sub = result.get(section)
         if isinstance(sub, dict):
             _check_types(section, sub, schema[section], errors)
@@ -164,6 +164,22 @@ def validate_result(result: dict, schema: dict | None = None) -> None:
                 else:
                     errors.append(
                         f"kv_pressure.arms[{i}]: {entry!r} is not an "
+                        f"object")
+    # Autoscale scenario: each policy arm (autoscaled / static) carries
+    # the slo_attainment / replica_minutes headline fields — validated
+    # element-wise so a rename in one arm's dict can't hide behind the
+    # list type.
+    autoscale = result.get("autoscale")
+    if isinstance(autoscale, dict):
+        arms = autoscale.get("policies")
+        if isinstance(arms, list):
+            for i, entry in enumerate(arms):
+                if isinstance(entry, dict):
+                    _check_types(f"autoscale.policies[{i}]", entry,
+                                 schema["autoscale_policy"], errors)
+                else:
+                    errors.append(
+                        f"autoscale.policies[{i}]: {entry!r} is not an "
                         f"object")
     breakdown = result.get("e2e_breakdown_ms")
     if isinstance(breakdown, dict):

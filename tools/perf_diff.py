@@ -36,6 +36,14 @@ metric                                  direction  source
 ``fleet.kv_transfer_pages@<policy>``    higher     fleet, per policy arm
                                                    (transfer arms only —
                                                    a 0 baseline skips)
+``autoscale.slo_attainment@<policy>``   higher     autoscale scenario,
+                                                   per arm (autoscaled /
+                                                   static)
+``autoscale.replica_minutes@<policy>``  lower      autoscale scenario,
+                                                   per arm — the bill:
+                                                   attainment gains must
+                                                   not hide behind a
+                                                   quietly fatter fleet
 ======================================  =========  =====================
 
 Accepts raw bench results or the driver's artifact wrapper (an object
@@ -71,6 +79,12 @@ _FLEET_DIRECTIONS = {"prefix_hit_rate": "higher",
                      "slo_attainment": "higher",
                      "ttft_p50_ms": "lower",
                      "kv_transfer_pages": "higher"}
+#: Autoscale-scenario headlines, per policy arm (autoscaled / static):
+#: attainment up, replica-minutes DOWN — the control loop is only a win
+#: if it attains more without quietly spending a fatter fleet.
+_AUTOSCALE_DIRECTIONS = {"slo_attainment": "higher",
+                         "replica_minutes": "lower",
+                         "ttft_p50_ms": "lower"}
 
 DEFAULT_THRESHOLD_PCT = 5.0
 
@@ -130,6 +144,18 @@ def extract_metrics(result: dict) -> dict[str, tuple[float, str]]:
                 v = _num(entry.get(key))
                 if v is not None:
                     out[f"fleet.{key}@{policy}"] = (v, direction)
+    autoscale = result.get("autoscale")
+    if isinstance(autoscale, dict):
+        for entry in autoscale.get("policies") or []:
+            if not isinstance(entry, dict):
+                continue
+            policy = entry.get("policy")
+            if not policy:
+                continue
+            for key, direction in _AUTOSCALE_DIRECTIONS.items():
+                v = _num(entry.get(key))
+                if v is not None:
+                    out[f"autoscale.{key}@{policy}"] = (v, direction)
     return out
 
 

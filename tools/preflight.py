@@ -30,6 +30,12 @@ Checks:
   ``/debug/requests`` timeline contracts (router/fleet.py schemas)
   validated element-wise over a synthetic-but-real router state built
   through the production table/recorder/window classes.
+- **autoscale** — the autoscale controller's decision-record and
+  ``GET /debug/autoscale`` contracts (router/autoscale.py schemas):
+  a real controller ticks over the synthetic fleet state and every
+  decision record + the endpoint payload validate element-wise, with
+  the overloaded state required to produce a scale-up decision (an
+  all-hold ring would validate while proving nothing).
 - **perf-gates** — ``tools/perf_diff.py`` over committed artifact
   pairs: each later round must not regress the earlier one's headline
   metrics (the same pairs/thresholds the tier-1 perf_diff test pins).
@@ -105,6 +111,25 @@ def check_bench_schema() -> list[str]:
                 for i in range(2)],
         },
     }
+    autoscale = {
+        "duration_s": 12.0, "trace": [[0.3, 1.0], [0.3, 6.0], [0.4, 1.0]],
+        "slo_ttft_ms": 2000.0, "deadline_ms": None, "num_tokens": 8,
+        "min_replicas": 1, "max_replicas": 3, "interval_s": 0.3,
+        "policies": [
+            {"policy": "autoscaled", "replicas_static": None,
+             "offered": 40, "completed": 38, "shed": 2, "errors": 0,
+             "slo_attainment": 0.9, "ttft_p50_ms": 120.0,
+             "replica_minutes": 0.4, "avg_replicas": 2.0,
+             "peak_replicas": 3, "scale_ups": 2, "scale_downs": 1,
+             "surge_rejections": 0, "decisions": 40},
+            {"policy": "static", "replicas_static": 2,
+             "offered": 40, "completed": 35, "shed": 5, "errors": 0,
+             "slo_attainment": 0.8, "ttft_p50_ms": 200.0,
+             "replica_minutes": 0.4, "avg_replicas": 2.0,
+             "peak_replicas": 2, "scale_ups": 0, "scale_downs": 0,
+             "surge_rejections": 0, "decisions": 0},
+        ],
+    }
     result = bench.assemble_result(
         kind="engine", model="preflight", headline=10.0,
         engine_p50=8.0, engine_p99=12.0, tput=100.0,
@@ -114,7 +139,8 @@ def check_bench_schema() -> list[str]:
         quant="none", kv_quant=None, weights="random-init",
         prompt_len=16, out_len=4, slots=2, steps_per_round=4,
         kv_pool_pages=8, device="cpu", rtt_ms=None, n_devices=1,
-        bench_seconds=1.0, fleet=fleet, kv_pressure=kv_pressure)
+        bench_seconds=1.0, fleet=fleet, kv_pressure=kv_pressure,
+        autoscale=autoscale)
     try:
         validate_result(result)
     except BenchSchemaError as exc:
@@ -247,6 +273,60 @@ def check_fleet_obs() -> list[str]:
     return errors
 
 
+def check_autoscale() -> list[str]:
+    """Tick a REAL AutoscaleController over the synthetic fleet state
+    and validate the decision ring + ``GET /debug/autoscale`` payload
+    element-wise (router/autoscale.py schemas). The seeded state is
+    overloaded (deep queue, utilization past the trigger), so the check
+    also requires a ``scale_up`` decision — proving the control law and
+    the contract together."""
+    import asyncio
+
+    sys.path.insert(0, REPO)
+    from generativeaiexamples_tpu.router import autoscale as rauto
+    from generativeaiexamples_tpu.router.server import FleetRouter
+
+    table, slo, recorder, _tl = synthetic_fleet_state()
+    # Overload r0: the queue is deep and the wall token rate consumes
+    # nearly all of the calibrated capacity.
+    table.update_health("r0", ok=True, body={
+        "draining": False,
+        "load": {"in_flight": 6, "queue_depth": 12, "rejected_total": 1,
+                 "prefix_hit_rate": 0.6},
+        "rounds": {"rounds_completed": 12, "tokens_per_sec": 4000.0,
+                   "wall_tokens_per_sec": 3800.0, "avg_device_ms": 8.0,
+                   "avg_bw_util": 0.7, "avg_drift_ratio": 1.0,
+                   "interleaved_share": 0.3},
+        "capacity": {"slots": 8, "decode_step_ms": 2.0,
+                     "model_source": "PROFILE_r09.json",
+                     "capacity_tokens_per_sec": 4000.0},
+    })
+    router = FleetRouter(table, flight=recorder)
+    controller = rauto.AutoscaleController(
+        router, policy=rauto.AutoscalePolicy(min_replicas=1,
+                                             max_replicas=4),
+        executor=None, surge=router.surge)
+    errors: list[str] = []
+    try:
+        records = [asyncio.run(controller.tick()) for _ in range(3)]
+    except Exception as exc:  # noqa: BLE001 — the check must report
+        return [f"controller tick raised: {exc!r}"]
+    snap = controller.snapshot()
+    errors.extend(rauto.validate_autoscale_snapshot(snap))
+    if not any(r["action"] in ("scale_up", "blocked")
+               and "utilization" in r["reason"] for r in records):
+        errors.append(
+            "overloaded synthetic fleet produced no utilization-driven "
+            "scale decision — the control law is no longer reading the "
+            "leading indicators")
+    if snap["decisions"] and snap["decisions"][-1]["evidence"][
+            "queue_depth"] != 12:
+        errors.append("decision evidence does not reflect the fleet "
+                      "snapshot's queue depth (the /debug/fleet join is "
+                      "broken)")
+    return errors
+
+
 def check_perf_gates(pairs=None) -> list[str]:
     sys.path.insert(0, REPO)
     from tools.perf_diff import diff_files
@@ -272,6 +352,7 @@ CHECKS: dict[str, Callable[[], list[str]]] = {
     "metrics-docs": check_metrics_docs,
     "metrics-lint": check_metrics_lint,
     "fleet-obs": check_fleet_obs,
+    "autoscale": check_autoscale,
     "perf-gates": check_perf_gates,
 }
 
