@@ -33,6 +33,10 @@ BENCH_SKIP_CHAT, BENCH_CHAT_TURNS, BENCH_CHAT_SYSTEM (multi-turn chat
 scenario: warm shared-prefix TTFT vs cold, engine prefix cache);
 BENCH_MODEL_PATH points at a real checkpoint dir (weights + tokenizer
 loaded via the import pipeline instead of random init);
+BENCH_MESH=tp=1,tp=2 runs the multi-chip serving sweep (one tp-sharded
+engine per mesh rung — decode tok/s + TTFT vs chips, topology-matched
+round budgets; ';' separates rungs whose spec itself has commas;
+BENCH_MESH_SLOTS/BENCH_MESH_REQUESTS size it).
 BENCH_SLOTS_SWEEP=8,16,32,64 additionally runs the slots-ladder
 capacity sweep (one engine per rung, schema-validated ``capacity``
 section — per-rung TTFT/throughput/HBM roofline).
@@ -530,6 +534,48 @@ def serve_apps(apps: list):
     return [f"http://127.0.0.1:{p}" for p in box["ports"]], stop
 
 
+def _sweep_pool_geometry(prompt_len: int, out_len: int,
+                         engine_overrides: dict,
+                         env_override: str = "") -> tuple[int, int]:
+    """Per-rung pool sizing shared by the capacity and multichip sweeps:
+    every slot holds its full decode window (prompt + 2x output, rounded
+    UP to the engine's power-of-two window rung — the jnp fallback path
+    gathers the bucketed window, not the exact page count) so
+    ``decode_window_steady`` holds by construction on both kernel and
+    fallback paths. Returns ``(page, per_slot_tokens)``;
+    ``env_override`` names an env var whose per-slot token count wins
+    (the capacity sweep's ``BENCH_SWEEP_KV_POOL_TOKENS``)."""
+    page = int(engine_overrides.get("page_size", 128))
+    need_pages = -(-(prompt_len + 2 * out_len + 2) // page)
+    win_pages = 1
+    while win_pages < need_pages:
+        win_pages *= 2
+    per_slot = win_pages * page
+    if env_override:
+        per_slot = int(os.environ.get(env_override, "0")) or per_slot
+    return page, per_slot
+
+
+def _sweep_engine_kw(slots: int, prompt_len: int, out_len: int,
+                     page: int, per_slot: int, kv_quant: str,
+                     steps_per_round: int, engine_overrides: dict,
+                     **extra) -> dict:
+    """One sweep rung's EngineConfig kwargs: production defaults, with
+    ``engine_overrides`` (tests: tiny page/bucket geometry) winning over
+    everything except the rung's slot count."""
+    kw = dict(
+        max_slots=slots, max_input_length=max(2048, prompt_len + 8),
+        max_output_length=max(128, 2 * out_len),
+        prefill_buckets=(512, 1024), dtype="bfloat16",
+        kv_pool_tokens=slots * per_slot + page,
+        kv_quant=kv_quant, steps_per_round=steps_per_round,
+        dispatch_depth=int(os.environ.get("BENCH_DISPATCH_DEPTH", "2")),
+        **extra)
+    kw.update(engine_overrides)
+    kw["max_slots"] = slots
+    return kw
+
+
 def run_capacity_sweep(params, model_cfg, tokenizer, rungs, *,
                        prompt_len: int, out_len: int, n_requests: int,
                        kv_quant: str = "", steps_per_round: int = 16,
@@ -550,28 +596,13 @@ def run_capacity_sweep(params, model_cfg, tokenizer, rungs, *,
     overrides (per-slot tokens) for HBM-constrained sweeps."""
     from generativeaiexamples_tpu.engine import Engine, EngineConfig
 
-    page = int(engine_overrides.get("page_size", 128))
-    need_pages = -(-(prompt_len + 2 * out_len + 2) // page)
-    win_pages = 1
-    while win_pages < need_pages:
-        win_pages *= 2
-    per_slot = int(os.environ.get("BENCH_SWEEP_KV_POOL_TOKENS", "0")) \
-        or win_pages * page
+    page, per_slot = _sweep_pool_geometry(
+        prompt_len, out_len, engine_overrides,
+        env_override="BENCH_SWEEP_KV_POOL_TOKENS")
     out = []
     for slots in rungs:
-        # engine_overrides (tests: tiny page/bucket geometry) win over
-        # the production defaults below.
-        kw = dict(
-            max_slots=slots, max_input_length=max(2048, prompt_len + 8),
-            max_output_length=max(128, 2 * out_len),
-            prefill_buckets=(512, 1024), dtype="bfloat16",
-            kv_pool_tokens=slots * per_slot + page,
-            kv_quant=kv_quant,
-            steps_per_round=steps_per_round,
-            dispatch_depth=int(os.environ.get("BENCH_DISPATCH_DEPTH",
-                                              "2")))
-        kw.update(engine_overrides)
-        kw["max_slots"] = slots
+        kw = _sweep_engine_kw(slots, prompt_len, out_len, page, per_slot,
+                              kv_quant, steps_per_round, engine_overrides)
         engine = Engine(params, model_cfg, tokenizer, EngineConfig(**kw))
         try:
             engine.prewarm()
@@ -608,6 +639,136 @@ def run_capacity_sweep(params, model_cfg, tokenizer, rungs, *,
         "output_len": out_len,
         "requests_per_rung": n_requests,
         "kv_pool_tokens_per_slot": per_slot,
+        "rungs": out,
+    }
+
+
+def parse_mesh_rung(spec: str) -> tuple[str, dict, int]:
+    """``"tp=2"`` (or ``"tp=2,sp=2"``) -> (canonical label, axis dict,
+    device count). ``"tp=1"`` is the single-chip rung (no mesh). Typo'd
+    axes fail loudly (``parallel.mesh.parse_mesh_spec``) — they would
+    otherwise abort the sweep mid-ladder or, worse, silently measure a
+    single-chip rung under a mesh-looking label."""
+    from generativeaiexamples_tpu.engine.scheduler import topology_key
+    from generativeaiexamples_tpu.parallel.mesh import parse_mesh_spec
+    axes = parse_mesh_spec(spec)
+    devices = 1
+    for v in axes.values():
+        devices *= v
+    return topology_key(axes), axes, devices
+
+
+def split_mesh_rungs(env: str) -> list[str]:
+    """``BENCH_MESH`` -> rung specs. ``;`` always separates rungs (the
+    unambiguous form for multi-axis meshes). Without one, a comma
+    starts a NEW rung only when its axis already appears in the rung
+    being built — a mesh never repeats an axis — so ``tp=1,tp=2,tp=4``
+    is three rungs while ``tp=2,sp=2`` stays one 4-device mesh."""
+    if ";" in env:
+        return [m.strip() for m in env.split(";") if m.strip()]
+    rungs: list[str] = []
+    current: list[str] = []
+    seen: set = set()
+    for part in (p.strip() for p in env.split(",") if p.strip()):
+        axis = part.partition("=")[0].strip()
+        if axis in seen:
+            rungs.append(",".join(current))
+            current, seen = [], set()
+        current.append(part)
+        seen.add(axis)
+    if current:
+        rungs.append(",".join(current))
+    return rungs
+
+
+def run_multichip_sweep(params, model_cfg, tokenizer, rungs, *,
+                        prompt_len: int, out_len: int, n_requests: int,
+                        slots: int = 8, kv_quant: str = "",
+                        steps_per_round: int = 16, spec: bool = False,
+                        **engine_overrides):
+    """Multi-chip serving sweep (``BENCH_MESH=tp=1,tp=2,...``): one
+    ENGINE per mesh rung over shared (re-sharded) params, each run
+    through the closed-loop TTFT + steady-decode measurement — the
+    proof rung that decode tokens/s scales and TTFT drops with chips,
+    now that the WHOLE decode hot path (fused sharded sampler tail,
+    speculative verify, topology-priced round budget) runs tp-sharded
+    instead of falling back. Each rung records the round budget the
+    engine derived BEFORE any traffic plus the cost row it came from
+    (``cost_source``/``cost_topology``) — the observable trail from
+    ``tools/profile_decode.py --mesh`` artifact to first-round
+    scheduling. On CPU, tier-1 drives this over the virtual 8-device
+    host platform (tests/test_bench_multichip.py)."""
+    import jax
+
+    from generativeaiexamples_tpu.engine import Engine, EngineConfig
+    from generativeaiexamples_tpu.parallel import MeshPlan, make_mesh
+
+    page, per_slot = _sweep_pool_geometry(prompt_len, out_len,
+                                          engine_overrides)
+    out = []
+    # Parse every rung spec BEFORE building any engine: a typo'd rung
+    # must fail the sweep upfront, not abort mid-ladder after paying for
+    # (and then discarding) the rungs already measured.
+    parsed = [parse_mesh_rung(str(r)) for r in rungs]
+    for label, axes, devices in parsed:
+        if devices > jax.local_device_count():
+            sys.stderr.write(
+                f"bench: mesh rung {label} needs {devices} devices, "
+                f"have {jax.local_device_count()}; skipping\n")
+            continue
+        mesh = None
+        if devices > 1:
+            mesh = make_mesh(MeshPlan(**axes), jax.devices()[:devices])
+        kw = _sweep_engine_kw(slots, prompt_len, out_len, page, per_slot,
+                              kv_quant, steps_per_round, engine_overrides,
+                              spec_decode=spec)
+        engine = Engine(params, model_cfg, tokenizer,
+                        EngineConfig(**kw), mesh=mesh)
+        try:
+            # Budget BEFORE traffic: the acceptance-relevant fact is the
+            # topology-matched PRIOR the first rounds plan under, not
+            # whatever the online calibrator converges to mid-run.
+            stats0 = engine.stats
+            cost = engine._sched._static_cost
+            engine.prewarm()
+            p50, p99, tput, _ = run_engine_bench(
+                engine, prompt_len, out_len, n_requests, slots)
+            stats = engine.stats
+            out.append({
+                "mesh": label,
+                "devices": devices,
+                "engine_p50_ttft_ms": round(p50, 2),
+                "engine_p99_ttft_ms": round(p99, 2),
+                "decode_tokens_per_sec": round(tput, 1),
+                "tokens_per_sec_per_device": round(tput / devices, 1),
+                # The first-seconds scheduling contract: the budget the
+                # engine derived from the topology-matched cost row at
+                # build time, and which artifact/row supplied it.
+                "sched_round_budget_tokens": int(
+                    stats0["sched_round_budget_tokens"]),
+                "cost_source": cost.source,
+                "cost_topology": cost.topology,
+                # Which tail actually served: the whole point of the
+                # sweep is that a mesh rung reads "fused_tp", not
+                # "materialized".
+                "tail": ("fused_tp" if engine._tail_sharded
+                         else "fused" if engine._fused_tail
+                         else "materialized"),
+                "engine_downgrades": int(stats["downgrades"]),
+                "spec": spec_snapshot({}, stats),
+            })
+        finally:
+            engine.stop()
+        import gc
+        gc.collect()
+    if not out:
+        return None
+    return {
+        "mesh_sweep": [label for label, _, _ in parsed],
+        "prompt_len": prompt_len,
+        "output_len": out_len,
+        "requests_per_rung": n_requests,
+        "slots": slots,
         "rungs": out,
     }
 
@@ -1377,7 +1538,8 @@ def assemble_result(*, kind, model, headline, engine_p50, engine_p99, tput,
                     kv_pool_pages, device, rtt_ms, n_devices,
                     bench_seconds, e2e_tps_p50=None, openloop=None,
                     fleet=None, capacity=None, rounds=None,
-                    kv_pressure=None, autoscale=None) -> dict:
+                    kv_pressure=None, autoscale=None,
+                    multichip=None) -> dict:
     """The bench's single output contract. Every field name here is
     pinned by tools/bench_schema.json (validated at emit time AND by the
     tier-1 suite, tests/test_bench_schema.py) so a rename fails fast
@@ -1429,6 +1591,11 @@ def assemble_result(*, kind, model, headline, engine_p50, engine_p99, tput,
         # TTFT/throughput/HBM-roofline — the BENCH_SWEEP_rNN table as
         # one validated section. Null when the sweep is not requested.
         "capacity": capacity,
+        # Multi-chip serving sweep (BENCH_MESH=tp=1,tp=2,...): one
+        # tp-sharded engine per mesh rung — decode tok/s and p50 TTFT
+        # vs chips, plus the topology-matched round budget each rung's
+        # scheduler started from. Null when the sweep is not requested.
+        "multichip": multichip,
         # KV-pressure scenario (BENCH_KV_PRESSURE): multi-turn chat at
         # working sets N× the KV pool, host tiering off vs on — warm
         # TTFT + restore hit rate per arm. Null when not requested.
@@ -1833,6 +2000,27 @@ def main() -> None:
         except Exception as exc:  # noqa: BLE001
             sys.stderr.write(f"bench: capacity sweep failed: {exc}\n")
 
+    # Multi-chip serving sweep (BENCH_MESH=tp=1,tp=2,...): one engine
+    # per mesh rung over the measured params (re-sharded per rung),
+    # main engine stopped. Degrades to multichip=null.
+    multichip = None
+    mesh_env = os.environ.get("BENCH_MESH", "")
+    if mesh_env:
+        try:
+            multichip = run_multichip_sweep(
+                engine.params, model_cfg, engine.tokenizer,
+                split_mesh_rungs(mesh_env),
+                prompt_len=prompt_len, out_len=out_len,
+                n_requests=int(os.environ.get("BENCH_MESH_REQUESTS",
+                                              "8")),
+                slots=int(os.environ.get("BENCH_MESH_SLOTS",
+                                         str(slots))),
+                kv_quant=engine.cfg.kv_quant,
+                steps_per_round=engine.cfg.steps_per_round,
+                spec=os.environ.get("BENCH_SPEC", "") not in ("", "0"))
+        except Exception as exc:  # noqa: BLE001
+            sys.stderr.write(f"bench: multichip sweep failed: {exc}\n")
+
     # KV-pressure scenario (BENCH_KV_PRESSURE=1,2,4): working sets N×
     # the pool, tiering off vs on. Fresh small engines over the
     # measured params, main engine stopped. Degrades to null.
@@ -1946,7 +2134,7 @@ def main() -> None:
         e2e_breakdown=e2e_breakdown, e2e_tps_p50=e2e_tps_p50,
         pipeline=pipeline, openloop=openloop, fleet=fleet,
         capacity=capacity, rounds=rounds, kv_pressure=kv_pressure,
-        autoscale=autoscale,
+        autoscale=autoscale, multichip=multichip,
         quant=quant, kv_quant=engine.cfg.kv_quant or None,
         weights=("real" if os.environ.get("BENCH_MODEL_PATH")
                  else "random-init"),

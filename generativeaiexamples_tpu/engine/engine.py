@@ -63,7 +63,9 @@ from ..obs import flight as obs_flight
 from ..obs import rounds as obs_rounds
 from ..obs.tracing import record_stage
 from ..ops.fused_sampler import (choose_tile, fused_unembed_sample,
+                                 fused_unembed_sample_tp,
                                  fused_verify_sample,
+                                 fused_verify_sample_tp, tp_shardable,
                                  verify_reference_tiled)
 from ..ops.sampling import (apply_repetition_penalty, mask_words,
                             pack_mask, pack_mask_np, sample, seen_mask,
@@ -80,7 +82,8 @@ from .kv_tier import BlockRecord, KVTier
 from .prefix_cache import PrefixCache, hash_blocks, usable_prefix_tokens
 from .sampling_params import SamplingParams
 from .scheduler import (OnlineCalibrator, PrefillJob, StepCostModel,
-                        TokenBudgetScheduler, online_calib_enabled)
+                        TokenBudgetScheduler, online_calib_enabled,
+                        topology_key)
 from .spec_decode import (AdaptiveDraftController, PromptLookupDrafter,
                           SpecConfig, spec_enabled)
 
@@ -183,6 +186,13 @@ _STATS_TEMPLATE = {
     # times recalibrate() actually moved the derived round budget —
     # 0 forever when SCHED_ONLINE_CALIB=0 or the budget is pinned.
     "sched_budget_recalibrations": 0,
+    # Construction-time feature downgrades (fused tail -> materialized,
+    # Pallas kernel -> jnp gather, ...): each one also logs a structured
+    # ``engine_feature_downgrade`` event. 0 on a fully-armed engine —
+    # > 0 means this engine serves correctly but below its hardware's
+    # potential, which used to be a silent comment-only fallback.
+    # (Mirrored as the ``engine_downgrades`` gauge.)
+    "downgrades": 0,
 }
 
 
@@ -303,9 +313,9 @@ class EngineConfig:
     # temperature>0 preserves the output distribution via rejection
     # sampling. ENGINE_SPEC_DECODE env beats this field (0 restores the
     # plain decode path); SPEC_MAX_DRAFT_TOKENS env beats the field
-    # below beats the default (docs/configuration.md). Single-chip
-    # only: under a mesh speculation is off (the verify tail rides the
-    # single-chip fused sampler contract).
+    # below beats the default (docs/configuration.md). Works on
+    # single-chip AND tp-sharded engines — the verify tail rides the
+    # same (sharded) fused or materialized sampler path as decode.
     spec_decode: bool = False
     spec_max_draft_tokens: Optional[int] = None
     # Tiered KV store (engine/kv_tier.py): host-RAM budget, in tokens,
@@ -556,6 +566,11 @@ class Engine:
         self.cfg = cfg
         self.tokenizer = tokenizer
         self.mesh = mesh
+        # Construction-time feature downgrades (observable, never
+        # silent): populated by _note_downgrade as topology/geometry
+        # gates resolve below, mirrored into the doc-fenced
+        # ``engine_downgrades`` stat once the stats dict exists.
+        self._downgrades: list[dict] = []
         self._dtype = jnp.dtype(cfg.dtype)
         self._kv_quant = bool(cfg.kv_quant)
         B, page = cfg.max_slots, cfg.page_size
@@ -619,8 +634,15 @@ class Engine:
         # copy (2x pool HBM) inside every decode round. Decided BEFORE
         # pool sizing: the auto sizer's headroom reserve depends on
         # whether the gather window ever materializes.
-        self._use_kernel = (llama.use_paged_kernel(model_cfg, page)
+        kernel_wanted = llama.use_paged_kernel(model_cfg, page)
+        self._use_kernel = (kernel_wanted
                             and llama.kernel_tp_compatible(model_cfg, mesh))
+        if kernel_wanted and not self._use_kernel:
+            self._note_downgrade(
+                "paged_kernel", "jnp_gather",
+                f"mesh {dict(mesh.shape)} cannot shard_map the Pallas "
+                f"decode kernel (heads {model_cfg.num_heads}/"
+                f"{model_cfg.num_kv_heads} must divide tp, pp must be 1)")
         self._pin_layouts = self._use_kernel
 
         # Page pool: physical page 0 is the trash page (never allocated);
@@ -700,7 +722,13 @@ class Engine:
         # the round recorder blend it toward this deployment's reality
         # and recalibrate() re-derives the budget between rounds.
         # =0 pins the static model — the pre-calibration behavior.
-        cost_prior = StepCostModel.load()
+        # The prior is TOPOLOGY-KEYED: a tp-sharded engine loads the
+        # artifact row measured at its own mesh shape
+        # (tools/profile_decode.py --mesh), so the budget the first
+        # rounds run under — before the calibrator has evidence — is
+        # derived from the right hardware, not the single-chip row.
+        cost_prior = StepCostModel.load(topology=topology_key(
+            dict(mesh.shape) if mesh is not None else None))
         self._calib = (OnlineCalibrator(cost_prior)
                        if online_calib_enabled() else None)
         self._sched = TokenBudgetScheduler(
@@ -768,22 +796,45 @@ class Engine:
         # Decode-attention page windows: power-of-two ladder up to the max.
         self._windows = _pow2_ladder(self._pmax)
 
-        # Fused vocab-tiled unembed+sampling tail (ops/fused_sampler.py):
-        # single-chip only — under a mesh the lm_head may shard over the
-        # vocab axis, and the per-tile dynamic_slice would need a
-        # collective per tile; mesh serving keeps the materialized tail.
+        # Fused vocab-tiled unembed+sampling tail (ops/fused_sampler.py).
+        # Under a tp mesh the lm_head shards over the vocab axis, so the
+        # tail runs SHARDED (fused_unembed_sample_tp): each chip streams
+        # its own vocab shard's 32-aligned tiles, folds penalties/masks
+        # locally, and the running argmax / Gumbel-top-k candidate carry
+        # + logsumexp merge with one small (B, cand_k) cross-chip
+        # collective at the end — (B, V) still never materializes on ANY
+        # chip (re-pinned by the sharded jaxpr memory proof). Geometries
+        # whose vocab cannot split into whole 32-token mask words per
+        # shard downgrade to the materialized tail — observably, via
+        # _note_downgrade, never as a silent comment-only fallback.
         # ENGINE_FUSED_SAMPLER=0 forces the materialized tail anywhere
         # (it doubles as the parity oracle in tests).
-        self._fused_tail = (self.mesh is None and os.environ.get(
-            "ENGINE_FUSED_SAMPLER", "1") != "0")
+        want_fused = os.environ.get("ENGINE_FUSED_SAMPLER", "1") != "0"
+        tp_size = (int(dict(mesh.shape).get("tp", 1))
+                   if mesh is not None else 1)
+        self._tail_sharded = False
+        self._head_specs = None
+        if want_fused and tp_size > 1:
+            if tp_shardable(model_cfg.vocab_size, tp_size):
+                self._tail_sharded = True
+                self._head_specs = llama.lm_head_specs(self.params, mesh)
+            else:
+                want_fused = False
+                self._note_downgrade(
+                    "fused_sampler", "materialized_tail",
+                    f"vocab_size={model_cfg.vocab_size} does not split "
+                    f"over tp={tp_size} into whole 32-token mask words")
+        self._fused_tail = want_fused
         # Speculative decoding (engine/spec_decode.py): host-side
         # prompt-lookup drafting + a batched verify round scoring
-        # S = max_draft + 1 positions per slot in ONE model step.
-        # Single-chip only (the verify tail rides the fused/materialized
-        # single-chip sampler paths; mesh serving keeps plain decode).
-        # ENGINE_SPEC_DECODE=0 restores the exact plain decode path.
+        # S = max_draft + 1 positions per slot in ONE model step. Runs
+        # on single-chip AND tp-sharded engines: the verify tail rides
+        # the same fused (sharded) or materialized sampler path as the
+        # decode tail, with identical greedy-token / rejection-sampling
+        # distribution guarantees (parity re-pinned on a sharded
+        # engine). ENGINE_SPEC_DECODE=0 restores the exact plain path.
         self._spec: Optional[SpecConfig] = None
-        if self.mesh is None and spec_enabled(cfg.spec_decode):
+        if spec_enabled(cfg.spec_decode):
             self._spec = SpecConfig.resolve(cfg.spec_max_draft_tokens)
         self._spec_S = (self._spec.max_draft_tokens + 1) if self._spec \
             else 0
@@ -808,6 +859,81 @@ class Engine:
         """Smallest active-row rung covering ``n`` armed slots."""
         n = max(1, n)
         return next(b for b in self._ba_ladder if b >= n)
+
+    def _note_downgrade(self, feature: str, fallback: str,
+                        reason: str) -> None:
+        """Record a construction-time feature downgrade OBSERVABLY: one
+        structured ``engine_feature_downgrade`` log event plus the
+        doc-fenced ``engine_downgrades`` stat (derived from this list at
+        read time). A downgraded engine still serves correctly, just
+        below its hardware's potential — which used to hide in code
+        comments (the PR-8/9 "mesh keeps the materialized tail" gates)
+        instead of in telemetry."""
+        self._downgrades.append(
+            {"feature": feature, "fallback": fallback, "reason": reason})
+        log_event(logger, "engine_feature_downgrade", feature=feature,
+                  fallback=fallback, reason=reason)
+
+    @property
+    def downgrades(self) -> list[dict]:
+        """Construction-time feature downgrades (copies)."""
+        return [dict(d) for d in self._downgrades]
+
+    # -------------------------------------------------- fused tail dispatch
+
+    def _tail_sample(self, params, ha, key, *, temp, top_k, top_p,
+                     rep_pen, seen_words, banned_words, ban_tok, ban_hit,
+                     greedy: bool):
+        """One fused unembed+sample call over already-normed hidden rows
+        ``ha`` (rows, D), routed to the single-chip tile stream or — on
+        a tp mesh — the sharded stream whose per-chip carries merge with
+        one small collective (ops/fused_sampler.py). Traced inside the
+        decode/verify round programs."""
+        mcfg = self.model_cfg
+        V = mcfg.vocab_size
+        if self._tail_sharded:
+            return fused_unembed_sample_tp(
+                self.mesh, "tp", llama.lm_head_subtree(params),
+                self._head_specs,
+                lambda head, rows, t0, tile: llama.lm_head_tile(
+                    head, mcfg, rows, t0, tile),
+                V, hn=ha, key=key, temp=temp, top_k=top_k, top_p=top_p,
+                rep_pen=rep_pen, seen_words=seen_words,
+                banned_words=banned_words, ban_tok=ban_tok,
+                ban_hit=ban_hit, greedy=greedy)
+        return fused_unembed_sample(
+            lambda t0, tile: llama.lm_head_tile(params, mcfg, ha, t0,
+                                                tile),
+            V, key=key, temp=temp, top_k=top_k, top_p=top_p,
+            rep_pen=rep_pen, seen_words=seen_words,
+            banned_words=banned_words, ban_tok=ban_tok, ban_hit=ban_hit,
+            greedy=greedy)
+
+    def _tail_verify(self, params, ha, key, u, *, temp, top_k, top_p,
+                     rep_pen, seen_words, banned_words, draft_ids,
+                     ban_tok, ban_hit):
+        """One fused verification call (rejection-sampling verdicts per
+        scored row) — same single-chip/sharded routing as
+        :meth:`_tail_sample`."""
+        mcfg = self.model_cfg
+        V = mcfg.vocab_size
+        if self._tail_sharded:
+            return fused_verify_sample_tp(
+                self.mesh, "tp", llama.lm_head_subtree(params),
+                self._head_specs,
+                lambda head, rows, t0, tile: llama.lm_head_tile(
+                    head, mcfg, rows, t0, tile),
+                V, hn=ha, key=key, u=u, temp=temp, top_k=top_k,
+                top_p=top_p, rep_pen=rep_pen, seen_words=seen_words,
+                banned_words=banned_words, draft_ids=draft_ids,
+                ban_tok=ban_tok, ban_hit=ban_hit)
+        return fused_verify_sample(
+            lambda t0, tile: llama.lm_head_tile(params, mcfg, ha, t0,
+                                                tile),
+            V, key=key, u=u, temp=temp, top_k=top_k, top_p=top_p,
+            rep_pen=rep_pen, seen_words=seen_words,
+            banned_words=banned_words, draft_ids=draft_ids,
+            ban_tok=ban_tok, ban_hit=ban_hit)
 
     def _init_device_state(self) -> dict:
         """Fresh device-side scheduler state (cache pool + slot arrays).
@@ -1249,6 +1375,9 @@ class Engine:
             round(out["spec_verify_tokens"]
                   / out["spec_verify_slot_steps"], 4)
             if out["spec_verify_slot_steps"] else 0.0)
+        # Construction-time feature downgrades — derived from the list
+        # (written once at build, before any reader exists).
+        out["downgrades"] = len(self._downgrades)
         # Model-vs-measured drift over completed rounds: 1.0 = the
         # step-cost model predicts round time; >1 = rounds run slower
         # than planned (regression, or a stale artifact prior); 0.0
@@ -1435,10 +1564,12 @@ class Engine:
                 runs the vocab-tiled unembed+sampler on (ba, …) shapes
                 only — a half-empty engine no longer unembeds max_slots
                 rows — and never materializes (B, V) penalized logits or
-                bool masks (ops/fused_sampler.py). The materialized tail
-                remains for mesh serving / ENGINE_FUSED_SAMPLER=0 and as
-                the parity oracle; the greedy variant of either tail is
-                a pure argmax (no vocab sort / no sampling noise)."""
+                bool masks (ops/fused_sampler.py; under a tp mesh the
+                tile stream is SHARDED per chip with one small carry
+                merge — see _tail_sample). The materialized tail remains
+                for ENGINE_FUSED_SAMPLER=0 / downgraded geometries and
+                as the parity oracle; the greedy variant of either tail
+                is a pure argmax (no vocab sort / no sampling noise)."""
                 def body(st, key_k):
                     pos, active = st["pos"], st["active"]
                     page_of = jnp.take_along_axis(
@@ -1461,13 +1592,8 @@ class Engine:
                         hit, tail = bad_seq_hits(st["bad_seq"][act_idx],
                                                  st["bad_len"][act_idx],
                                                  st["recent"][act_idx])
-
-                        def tile_fn(t0, tile):
-                            return llama.lm_head_tile(params, mcfg, ha,
-                                                      t0, tile)
-
-                        tok_a = fused_unembed_sample(
-                            tile_fn, V, key=key_k,
+                        tok_a = self._tail_sample(
+                            params, ha, key_k,
                             temp=st["temp"][act_idx],
                             top_k=st["top_k"][act_idx],
                             top_p=st["top_p"][act_idx],
@@ -1611,21 +1737,17 @@ class Engine:
                                        axis=0)
                     draft_r = draft_grid[act_idx].reshape(ba * S)
 
-                    def tile_fn(t0, tile):
-                        return llama.lm_head_tile(params, mcfg, ha, t0,
-                                                  tile)
-
                     if greedy:
-                        tgt = fused_unembed_sample(
-                            tile_fn, V, key=key_g, temp=temp_r,
+                        tgt = self._tail_sample(
+                            params, ha, key_g, temp=temp_r,
                             top_k=tk_r, top_p=tp_r, rep_pen=rp_r,
                             seen_words=seen_r, banned_words=ban_r,
                             ban_tok=tail, ban_hit=hit, greedy=True)
                         acc_r, out_r = draft_r == tgt, tgt
                     else:
                         u = jax.random.uniform(key_u, (ba * S,))
-                        acc_r, out_r = fused_verify_sample(
-                            tile_fn, V, key=key_g, u=u, temp=temp_r,
+                        acc_r, out_r = self._tail_verify(
+                            params, ha, key_g, u, temp=temp_r,
                             top_k=tk_r, top_p=tp_r, rep_pen=rp_r,
                             seen_words=seen_r, banned_words=ban_r,
                             draft_ids=draft_r, ban_tok=tail, ban_hit=hit)
@@ -2968,6 +3090,27 @@ class Engine:
         with self._pipe_lock:
             return self._inflight_rounds
 
+    def _assert_harvestable(self, *arrays) -> None:
+        """Sharded-serving harvest contract: every array headed for the
+        harvest queue must materialize with ONE ``np.asarray`` and no
+        implicit cross-host gather — per-round outputs are small
+        REPLICATED arrays by construction (the sharded tail's out_specs
+        replicate tokens/verdicts; scatters of replicated operands stay
+        replicated). A violation means a dispatch returned
+        device-SHARDED output the harvest thread would silently gather
+        per round (cross-device always, cross-host on a multi-host
+        slice): fail loudly at dispatch instead. Metadata check only —
+        never a device sync."""
+        if self.mesh is None:
+            return
+        for a in arrays:
+            if not getattr(a, "is_fully_replicated", True):
+                raise EngineError(
+                    "round output is not replicated (sharding "
+                    f"{getattr(a, 'sharding', None)!r}); harvest would "
+                    "implicitly gather it every round — sharded round "
+                    "outputs must be small replicated arrays")
+
     def _drain_completed(self) -> bool:
         """Scheduler-side half of request completion: the harvest worker
         finished these streams (terminal chunk + sentinel already
@@ -3441,6 +3584,7 @@ class Engine:
                 # signal for prefill work that otherwise produces no
                 # readback until a slot arms.
                 parts += 1
+                self._assert_harvestable(marker)
                 self._harvest_q.put(("mark", rec, marker))
             if parts == 0:
                 self.rounds.discard(rec)
@@ -3835,6 +3979,7 @@ class Engine:
             pass
         req.pf = None
         req.prefill_done = True
+        self._assert_harvestable(first_tok)
         self._harvest_q.put(("first", req, first_tok, rec))
 
     def _dispatch_rag(self, req: _Request, rec=None
@@ -3900,7 +4045,8 @@ class Engine:
         # Active-slot compaction: the fused tail unembeds/samples only
         # the armed slots, padded to the smallest compiled rung (padding
         # indices == max_slots: gathers clamp, scatters drop). The
-        # materialized tail (mesh serving) always runs full-width.
+        # materialized tail (ENGINE_FUSED_SAMPLER=0 / downgraded
+        # geometry) always runs full-width.
         B = self.cfg.max_slots
         ba = self._ba_for(len(members)) if self._fused_tail else B
         act = np.full((ba,), B, np.int32)
@@ -3943,6 +4089,7 @@ class Engine:
         with self._stats_lock:
             if depth > self._stats["dispatch_depth_peak"]:
                 self._stats["dispatch_depth_peak"] = depth
+        self._assert_harvestable(toks)
         self._harvest_q.put(("round", members, toks, rec))
         self._bump("decode_steps", steps)
         return True
@@ -4023,6 +4170,7 @@ class Engine:
         with self._stats_lock:
             if depth > self._stats["dispatch_depth_peak"]:
                 self._stats["dispatch_depth_peak"] = depth
+        self._assert_harvestable(toks, acc)
         self._harvest_q.put(("verify", members, toks, acc, drafted, rec))
         self._bump("decode_steps")
         self._bump("spec_verify_rounds")

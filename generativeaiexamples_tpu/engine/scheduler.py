@@ -51,6 +51,21 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))))
 
 
+def topology_key(mesh_shape: Optional[dict] = None) -> str:
+    """Canonical topology label for cost-model rows: the mesh's
+    non-trivial axes as ``axis=N`` pairs, sorted (``"tp=2"``,
+    ``"sp=2,tp=2"``); a single chip — or a mesh of all-1 axes — is
+    ``"tp=1"``. The same function labels ``tools/profile_decode.py
+    --mesh`` artifacts and keys the engine's prior lookup, so the two
+    can never drift apart. Takes a plain ``{axis: size}`` dict (this
+    module stays jax-free): engines pass ``dict(mesh.shape)``."""
+    if not mesh_shape:
+        return "tp=1"
+    parts = [f"{a}={int(s)}" for a, s in sorted(mesh_shape.items())
+             if int(s) > 1]
+    return ",".join(parts) if parts else "tp=1"
+
+
 @dataclass(frozen=True)
 class StepCostModel:
     """Per-deployment serving costs, in milliseconds.
@@ -80,10 +95,17 @@ class StepCostModel:
     d2h_ms_per_page: float = 0.0
     slots: int = 8
     source: str = "default"
+    # The mesh shape these costs were measured at (``topology_key``
+    # label; artifacts without one are single-chip measurements). A
+    # tp-sharded engine must plan its FIRST rounds from the matching
+    # row — a 2-chip decode step costs neither one chip's step nor
+    # half of it, and the online calibrator only fixes the prior after
+    # real traffic has already been (mis-)budgeted.
+    topology: str = "tp=1"
 
     @classmethod
-    def from_profile(cls, profile: dict, source: str = "profile"
-                     ) -> "StepCostModel":
+    def from_profile(cls, profile: dict, source: str = "profile",
+                     topology: Optional[str] = None) -> "StepCostModel":
         decode = float(profile.get("full_ms_per_step") or 2.0)
         slots = int(profile.get("slots") or 8)
         prefill = profile.get("prefill_ms_per_token")
@@ -103,15 +125,48 @@ class StepCostModel:
                    verify_ms_per_token=float(verify),
                    h2d_ms_per_page=float(h2d),
                    d2h_ms_per_page=float(d2h),
-                   slots=slots, source=source)
+                   slots=slots, source=source,
+                   topology=str(topology or profile.get("topology")
+                                or "tp=1"))
 
     @classmethod
-    def load(cls, path: Optional[str] = None) -> "StepCostModel":
+    def _from_artifact(cls, profile: dict, topology: Optional[str],
+                       source: str) -> Optional["StepCostModel"]:
+        """One artifact's topology-matched model, or None when it has no
+        row for the requested topology. Artifacts carry their own
+        ``topology`` label (absent == single-chip ``tp=1``) and may
+        carry a ``topologies`` dict of per-mesh rows (each row's keys
+        override the artifact's shared fields) — one sweep run can
+        serve every rung."""
+        own = str(profile.get("topology") or "tp=1")
+        if topology is None or topology == own:
+            return cls.from_profile(profile, source=source, topology=own)
+        rows = profile.get("topologies")
+        if isinstance(rows, dict) and isinstance(rows.get(topology),
+                                                 dict):
+            merged = {k: v for k, v in profile.items()
+                      if k != "topologies"}
+            merged.update(rows[topology])
+            return cls.from_profile(merged,
+                                    source=f"{source}@{topology}",
+                                    topology=topology)
+        return None
+
+    @classmethod
+    def load(cls, path: Optional[str] = None,
+             topology: Optional[str] = None) -> "StepCostModel":
         """Resolve the deployment's cost model: explicit ``path``, else
         ``SCHED_PROFILE_JSON``, else the newest committed
         ``PROFILE_rNN.json`` at the repo root, else defaults. A missing
         or malformed artifact degrades silently to defaults — the
-        scheduler must never keep an engine from building."""
+        scheduler must never keep an engine from building.
+
+        ``topology``: the engine's mesh label (:func:`topology_key`).
+        Precedence per docs/scheduler.md: an artifact whose own label or
+        ``topologies`` row matches wins; with NO matching row anywhere,
+        the newest parseable artifact is used as-is (its ``topology``
+        field then records the mismatch) — a wrong-but-measured prior
+        beats built-in defaults, and the online calibrator converges it."""
         candidates = []
         if path:
             candidates.append(path)
@@ -126,6 +181,7 @@ class StepCostModel:
         candidates.extend(sorted(
             glob.glob(os.path.join(_REPO_ROOT, "PROFILE_r*.json")),
             key=_round_no, reverse=True))
+        fallback: Optional["StepCostModel"] = None
         for cand in candidates:
             # Catch the full malformed-artifact surface, not just parse
             # errors: valid JSON that isn't an object of numbers (`[]`,
@@ -134,12 +190,18 @@ class StepCostModel:
             # covers those the same as a missing file.
             try:
                 with open(cand) as f:
-                    return cls.from_profile(json.load(f),
-                                            source=os.path.basename(cand))
+                    profile = json.load(f)
+                model = cls._from_artifact(profile, topology,
+                                           os.path.basename(cand))
+                if model is not None:
+                    return model
+                if fallback is None:
+                    fallback = cls.from_profile(
+                        profile, source=os.path.basename(cand))
             except (OSError, ValueError, TypeError, AttributeError,
                     KeyError):
                 continue
-        return cls()
+        return fallback if fallback is not None else cls()
 
     def prefill_s(self, tokens: int) -> float:
         """Modeled wall seconds to prefill ``tokens`` prompt tokens."""

@@ -862,6 +862,44 @@ def unembed_norm(params: Params, cfg: LlamaConfig, h: jax.Array
 _HEAD_VOCAB_LEAVES = ("q", "q4", "scale", "gscale", "gbias")
 
 
+def lm_head_subtree(params: Params) -> dict:
+    """The unembed-weight leaves as a standalone mini-tree — the
+    shard_map operand of the tp-sharded fused sampler
+    (ops/fused_sampler.py ``fused_unembed_sample_tp``). Keeps the
+    ``lm_head``/``embed`` key so :func:`lm_head_tile` works on the
+    LOCAL shard unchanged inside the shard_map body."""
+    head = params.get("lm_head")
+    if head is None:
+        return {"embed": params["embed"]}
+    return {"lm_head": head}
+
+
+def lm_head_specs(params: Params, mesh, axis: str = "tp") -> dict:
+    """PartitionSpecs for :func:`lm_head_subtree`, mirroring
+    ``parallel.sharding``'s placement rules (vocab axis over ``tp``;
+    quantized dicts follow ``shard_params``' per-leaf derivation) — the
+    ``in_specs`` of the sharded fused-sampler tail."""
+    from jax.sharding import PartitionSpec as P
+    tp = axis if int(mesh.shape.get(axis, 1)) > 1 else None
+    head = params.get("lm_head")
+    # Tied embedding (V, D): vocab is the LEADING axis.
+    if head is None:
+        return {"embed": P(tp, None)}
+
+    def leaf(k):
+        # mirrors shard_params' QTensor rules for w_spec = (None, tp):
+        # vocab-axis leaves keep it; the (V,) scale drops the reduction
+        # axis; pre_scale (D,) stays replicated.
+        if k in ("q", "q4", "gscale", "gbias"):
+            return P(None, tp)
+        if k == "pre_scale":
+            return P(None)
+        return P(tp)
+    if isinstance(head, dict):
+        return {"lm_head": {k: leaf(k) for k in head}}
+    return {"lm_head": P(None, tp)}
+
+
 def lm_head_tile(params: Params, cfg: LlamaConfig, hn: jax.Array,
                  t0: jax.Array, tile: int) -> jax.Array:
     """Project already-normed hidden states onto ONE vocab tile:

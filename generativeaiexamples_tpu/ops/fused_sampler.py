@@ -21,13 +21,27 @@ running state across tiles:
   from the candidate set alone, with an exact running logsumexp for the
   top-p mass. Full penalized logits never exist in any buffer.
 
+Tensor-parallel serving (``fused_unembed_sample_tp`` /
+``fused_verify_sample_tp``): the same stream runs SHARDED over the
+mesh's ``tp`` axis — each chip streams only its own vocab shard's
+32-aligned tiles (its slice of the tp-sharded ``lm_head``), folds
+penalties/masks locally against the replicated bitfields, and carries
+the identical running state. At the end of the stream ONE small
+cross-chip merge combines the per-shard carries: an ``all_gather`` of
+the ``(B, cand_k)`` candidate rows (stable top-k over the shard-ordered
+concatenation — ties keep ascending vocab id, exactly the single-chip
+tie rule), a running-argmax reduce for the greedy/Gumbel-max winners,
+and a ``logsumexp`` fold of the per-shard mass. ``(B, V)`` never exists
+on ANY chip; the collective payload is O(B·cand_k), not O(B·V).
+
 Exactness: greedy, pure temperature sampling (no truncation), and any
 top-k/top-p whose kept prefix fits in ``cand_k`` candidates are
 *sample-exact* against :func:`sample_reference_tiled` (the materialized
 penalize-then-sample oracle sharing the same per-tile noise layout) —
-pinned by tier-1 tests. A top-p set wider than ``cand_k`` tokens is
-truncated at ``cand_k`` (vLLM-style candidate cap; raise
-``SAMPLER_CAND_K`` to widen).
+pinned by tier-1 tests, sharded paths included (the tp stream consumes
+the same per-tile Gumbel field, indexed by GLOBAL tile number). A top-p
+set wider than ``cand_k`` tokens is truncated at ``cand_k`` (vLLM-style
+candidate cap; raise ``SAMPLER_CAND_K`` to widen).
 """
 
 from __future__ import annotations
@@ -63,6 +77,17 @@ def choose_tile(vocab_size: int, target: int | None = None) -> int:
             if vocab_size % t == 0:
                 return t
     return vocab_size
+
+
+def tp_shardable(vocab_size: int, n_shards: int) -> bool:
+    """Whether the vocab stream can shard over ``n_shards`` chips: each
+    shard must own an equal slice whose tiles still cover whole mask
+    words (the per-tile bitfield slice stays a contiguous
+    dynamic_slice). Real vocabs divide cleanly for any power-of-two tp;
+    failing geometries keep the materialized tail (the engine logs an
+    ``engine_feature_downgrade``)."""
+    return (n_shards > 1 and vocab_size % n_shards == 0
+            and (vocab_size // n_shards) % MASK_BITS == 0)
 
 
 def _slice_tile_mask(words: jax.Array, t0: jax.Array, tile: int,
@@ -104,52 +129,46 @@ def _penalize_tile(logits, t0, tile, *, seen_words, banned_words, rep_pen,
     return lf
 
 
-def fused_unembed_sample(tile_logits_fn, vocab_size: int, *, key, temp,
-                         top_k, top_p, rep_pen, seen_words, banned_words,
-                         ban_tok=None, ban_hit=None, greedy: bool = False,
-                         tile: int | None = None,
-                         cand_k: int | None = None) -> jax.Array:
-    """Stream the vocab in tiles and sample without materializing it.
+# --------------------------------------------------------- tile streams
+#
+# The scan bodies shared by the single-chip and tp-sharded paths. Each
+# takes ``masked_tile(t) -> (t0, lf)`` producing the PENALIZED (B, tile)
+# logits for local tile ``t`` with GLOBAL token offset ``t0``, and
+# ``noise_tile(t)`` mapping the local tile number to the global tile
+# index the Gumbel field is keyed on — so a shard streaming tiles
+# [k, k+n) consumes exactly the noise the whole-vocab stream would have
+# at those tiles, and sharded sampling stays sample-exact.
 
-    tile_logits_fn(t0, tile) -> (B, tile) f32 raw logits for tokens
-    [t0, t0+tile) — typically a sliced lm_head projection
-    (models/llama.py ``lm_head_tile``). Returns (B,) int32 tokens with
-    the semantics of ``ops.sampling.sample`` applied to the penalized
-    logits (greedy when ``greedy`` — trace-time, the engine's all-greedy
-    round variant — no noise, no candidate carry, just a running argmax).
-    """
-    tile = choose_tile(vocab_size, tile)
-    cand_k = cand_k or default_cand_k()
-    n_tiles = vocab_size // tile
-    probe = jax.eval_shape(lambda: tile_logits_fn(jnp.int32(0), tile))
-    B = probe.shape[0]
 
-    def masked_tile(t):
-        t0 = (t * tile).astype(jnp.int32)
-        lf = _penalize_tile(
-            tile_logits_fn(t0, tile), t0, tile, seen_words=seen_words,
-            banned_words=banned_words, rep_pen=rep_pen,
-            ban_tok=ban_tok, ban_hit=ban_hit)
-        return t0, lf
+def _greedy_stream(masked_tile, n_tiles: int, tile: int, B: int):
+    """Running argmax over the tile stream: (best value, best id), ties
+    keeping the lowest vocab id (first tile wins; within a tile argmax
+    picks the lowest index)."""
 
-    if greedy:
-        def body(carry, t):
-            best, best_id = carry
-            t0, lf = masked_tile(t)
-            ids = t0 + jnp.arange(tile, dtype=jnp.int32)
-            tbest = jnp.max(lf, axis=-1)
-            tid = jnp.take(ids, jnp.argmax(lf, axis=-1))
-            better = tbest > best
-            return (jnp.where(better, tbest, best),
-                    jnp.where(better, tid, best_id)), None
+    def body(carry, t):
+        best, best_id = carry
+        t0, lf = masked_tile(t)
+        ids = t0 + jnp.arange(tile, dtype=jnp.int32)
+        tbest = jnp.max(lf, axis=-1)
+        tid = jnp.take(ids, jnp.argmax(lf, axis=-1))
+        better = tbest > best
+        return (jnp.where(better, tbest, best),
+                jnp.where(better, tid, best_id)), None
 
-        init = (jnp.full((B,), -jnp.inf, jnp.float32),
-                jnp.zeros((B,), jnp.int32))
-        (_, best_id), _ = jax.lax.scan(
-            body, init, jnp.arange(n_tiles, dtype=jnp.int32))
-        return best_id
+    init = (jnp.full((B,), -jnp.inf, jnp.float32),
+            jnp.zeros((B,), jnp.int32))
+    (best, best_id), _ = jax.lax.scan(
+        body, init, jnp.arange(n_tiles, dtype=jnp.int32))
+    return best, best_id
 
-    tf = jnp.maximum(temp, 1e-6)[:, None]
+
+def _sample_stream(masked_tile, noise_tile, key, tf, n_tiles: int,
+                   tile: int, B: int, cand_k: int):
+    """Sampling carry over the tile stream. Returns
+    ``(cv, ci, cp, lse, bpert, bpid, braw, brid)``: the top-``cand_k``
+    raw scaled values with ids + Gumbel perturbations, the running
+    logsumexp, the untruncated Gumbel-max winner, and the running greedy
+    argmax (for temp<=0 / top_k==1 rows of the batch)."""
 
     def body(carry, t):
         cv, ci, cp, lse, bpert, bpid, braw, brid = carry
@@ -157,7 +176,7 @@ def fused_unembed_sample(tile_logits_fn, vocab_size: int, *, key, temp,
         ids = t0 + jnp.arange(tile, dtype=jnp.int32)
         idb = jnp.broadcast_to(ids, lf.shape)
         scaled = lf / tf
-        g = jax.random.gumbel(jax.random.fold_in(key, t),
+        g = jax.random.gumbel(jax.random.fold_in(key, noise_tile(t)),
                               (B, tile), jnp.float32)
         pert = scaled + g
         # running logsumexp of the scaled logits (exact top-p mass)
@@ -194,9 +213,82 @@ def fused_unembed_sample(tile_logits_fn, vocab_size: int, *, key, temp,
             jnp.zeros((B,), jnp.int32),
             jnp.full((B,), -jnp.inf, jnp.float32),
             jnp.zeros((B,), jnp.int32))
-    (cv, ci, cp, lse, _, bpid, _, brid), _ = jax.lax.scan(
-        body, init, jnp.arange(n_tiles, dtype=jnp.int32))
+    carry, _ = jax.lax.scan(body, init,
+                            jnp.arange(n_tiles, dtype=jnp.int32))
+    return carry
 
+
+def _verify_stream(masked_tile, noise_tile, key, tf, draft_ids,
+                   n_tiles: int, tile: int, R: int, cand_k: int):
+    """Verification carry over the tile stream. Returns
+    ``(cv, ci, cp, lse, braw, brid, sd, sfound, npert, npid)`` — the
+    sampling carry pieces plus the draft token's accumulated scaled
+    logit (``sd``; the draft lives in exactly one tile of one shard, so
+    a masked sum — and, sharded, a psum — is a gather), whether the
+    draft id was seen at all, and the draft-masked running Gumbel-max
+    (the untruncated residual sample)."""
+
+    def body(carry, t):
+        (cv, ci, cp, lse, braw, brid, sd, sfound, npert, npid) = carry
+        t0, lf = masked_tile(t)
+        ids = t0 + jnp.arange(tile, dtype=jnp.int32)
+        idb = jnp.broadcast_to(ids, lf.shape)
+        scaled = lf / tf
+        g = jax.random.gumbel(jax.random.fold_in(key, noise_tile(t)),
+                              (R, tile), jnp.float32)
+        pert = scaled + g
+        lse = jnp.logaddexp(lse, jax.nn.logsumexp(scaled, axis=-1))
+        # running greedy argmax (greedy rows + the greedy accept test)
+        rb = jnp.max(lf, axis=-1)
+        ri = jnp.take_along_axis(idb, jnp.argmax(lf, -1)[:, None],
+                                 axis=1)[:, 0]
+        ug = rb > braw
+        braw, brid = jnp.where(ug, rb, braw), jnp.where(ug, ri, brid)
+        # the draft token's scaled logit (each id lives in exactly one
+        # tile, so a masked sum is a gather)
+        dm = idb == draft_ids[:, None]
+        sd = sd + jnp.sum(jnp.where(dm, scaled, 0.0), axis=-1)
+        sfound = sfound | jnp.any(dm, axis=-1)
+        # running Gumbel-argmax with the draft masked: the UNTRUNCATED
+        # residual sample (draft -1 matches nothing -> plain sample)
+        pert_nod = jnp.where(dm, -jnp.inf, pert)
+        nb = jnp.max(pert_nod, axis=-1)
+        ni = jnp.take_along_axis(idb, jnp.argmax(pert_nod, -1)[:, None],
+                                 axis=1)[:, 0]
+        un = nb > npert
+        npert, npid = jnp.where(un, nb, npert), jnp.where(un, ni, npid)
+        # candidate merge (identical to the sampling stream: carry-first
+        # preserves the oracle's stable tie order)
+        av = jnp.concatenate([cv, scaled], axis=-1)
+        ai = jnp.concatenate([ci, idb], axis=-1)
+        ap = jnp.concatenate([cp, pert], axis=-1)
+        cv, sel = jax.lax.top_k(av, cand_k)
+        ci = jnp.take_along_axis(ai, sel, axis=-1)
+        cp = jnp.take_along_axis(ap, sel, axis=-1)
+        return (cv, ci, cp, lse, braw, brid, sd, sfound, npert, npid), None
+
+    init = (jnp.full((R, cand_k), -jnp.inf, jnp.float32),
+            jnp.zeros((R, cand_k), jnp.int32),
+            jnp.full((R, cand_k), -jnp.inf, jnp.float32),
+            jnp.full((R,), -jnp.inf, jnp.float32),
+            jnp.full((R,), -jnp.inf, jnp.float32),
+            jnp.zeros((R,), jnp.int32),
+            jnp.zeros((R,), jnp.float32),
+            jnp.zeros((R,), bool),
+            jnp.full((R,), -jnp.inf, jnp.float32),
+            jnp.zeros((R,), jnp.int32))
+    carry, _ = jax.lax.scan(body, init,
+                            jnp.arange(n_tiles, dtype=jnp.int32))
+    return carry
+
+
+# ------------------------------------------------------------ finalizers
+
+
+def _finalize_sample(cv, ci, cp, lse, bpid, brid, *, temp, top_k, top_p,
+                     vocab_size: int, cand_k: int) -> jax.Array:
+    """Resolve top-k/top-p truncation from the candidate carry alone and
+    pick the sampled (or greedy) token per row."""
     V = vocab_size
     kk = jnp.where(top_k <= 0, V, top_k)
     p = jnp.where((top_p <= 0) | (top_p >= 1.0), 1.0, top_p)
@@ -215,6 +307,209 @@ def fused_unembed_sample(tile_logits_fn, vocab_size: int, *, key, temp,
     sampled = jnp.where(untruncated, bpid, trunc_tok)
     is_greedy = (temp <= 0) | (top_k == 1)
     return jnp.where(is_greedy, brid, sampled).astype(jnp.int32)
+
+
+def _finalize_verify(cv, ci, cp, lse, brid, sd, sfound, npid, *, u, temp,
+                     top_k, top_p, draft_ids, vocab_size: int,
+                     cand_k: int) -> tuple[jax.Array, jax.Array]:
+    """Resolve the per-row accept/resample verdicts from the carry."""
+    sd = jnp.where(sfound, sd, -jnp.inf)
+    V = vocab_size
+    kk = jnp.where(top_k <= 0, V, top_k)
+    p = jnp.where((top_p <= 0) | (top_p >= 1.0), 1.0, top_p)
+    probs = jnp.exp(cv - lse[:, None])
+    cum_before = jnp.cumsum(probs, axis=-1) - probs
+    keep = ((jnp.arange(cand_k)[None, :] < kk[:, None])
+            & (cum_before < p[:, None]))
+    # Truncated target: normalizer over the KEPT candidates only; the
+    # draft's probability is exp(scaled_d - Z_kept) when the draft made
+    # the kept set, else exactly 0.
+    z_kept = jax.nn.logsumexp(jnp.where(keep, cv, -jnp.inf), axis=-1)
+    is_draft = ci == draft_ids[:, None]
+    draft_kept = jnp.any(is_draft & keep, axis=-1)
+    p_trunc = jnp.where(draft_kept, jnp.exp(sd - z_kept), 0.0)
+    # Truncated residual: Gumbel-argmax over kept candidates minus the
+    # draft.  A kept set of exactly {draft} has an empty residual — but
+    # then p(draft) == 1 and the residual is never consumed; fall back
+    # to the draft itself so a float-rounded reject can't emit ci[0].
+    kept_res = keep & ~is_draft
+    res_pert = jnp.where(kept_res, cp, -jnp.inf)
+    trunc_res = jnp.take_along_axis(
+        ci, jnp.argmax(res_pert, -1)[:, None], axis=1)[:, 0]
+    trunc_res = jnp.where(jnp.any(kept_res, axis=-1), trunc_res,
+                          draft_ids)
+    untruncated = (kk >= V) & (p >= 1.0)
+    p_acc = jnp.where(untruncated, jnp.exp(sd - lse), p_trunc)
+    resample = jnp.where(untruncated, npid, trunc_res)
+    accept = u < p_acc
+    out_tok = resample.astype(jnp.int32)
+    is_greedy = (temp <= 0) | (top_k == 1)
+    accept = jnp.where(is_greedy, draft_ids == brid, accept)
+    out_tok = jnp.where(is_greedy, brid, out_tok)
+    return accept, out_tok
+
+
+# ----------------------------------------------------- cross-chip merges
+
+
+def _merge_running_max(axis: str, val, idx):
+    """Combine per-shard running-argmax carries: strictly-greater wins,
+    ties keep the LOWEST shard — shard order == ascending vocab ranges,
+    so the global tie rule stays "lowest vocab id", identical to the
+    single-chip stream."""
+    vs = jax.lax.all_gather(val, axis)          # (n_shards, B)
+    ids = jax.lax.all_gather(idx, axis)
+    win = jnp.argmax(vs, axis=0)                # first max -> lowest shard
+    take = lambda a: jnp.take_along_axis(a, win[None, :], axis=0)[0]  # noqa: E731
+    return take(vs), take(ids)
+
+
+def _merge_candidates(axis: str, cv, ci, cp, cand_k: int):
+    """Combine per-shard candidate carries: gather the (B, cand_k) rows
+    shard-major and re-take the stable top-k. Each global top-cand_k
+    element is within its own shard's top-cand_k, so the merge is exact;
+    stable top_k over the shard-ordered concatenation keeps ascending-id
+    tie order, matching the single-chip carry-first rule. This gather is
+    the ONLY place candidate state crosses the interconnect: O(B·cand_k)
+    per merge, never O(B·V)."""
+    gv = jax.lax.all_gather(cv, axis)           # (n_shards, B, cand_k)
+    gi = jax.lax.all_gather(ci, axis)
+    gp = jax.lax.all_gather(cp, axis)
+    flat = lambda a: jnp.moveaxis(a, 0, 1).reshape(  # noqa: E731
+        a.shape[1], -1)
+    av, ai, ap = flat(gv), flat(gi), flat(gp)
+    cv2, sel = jax.lax.top_k(av, cand_k)
+    return (cv2, jnp.take_along_axis(ai, sel, axis=-1),
+            jnp.take_along_axis(ap, sel, axis=-1))
+
+
+def _merge_lse(axis: str, lse):
+    return jax.nn.logsumexp(jax.lax.all_gather(lse, axis), axis=0)
+
+
+def _shard_geometry(mesh, axis: str, vocab_size: int,
+                    tile: int | None) -> tuple[int, int, int]:
+    n_shards = int(mesh.shape[axis])
+    if not tp_shardable(vocab_size, n_shards):
+        raise ValueError(
+            f"vocab_size={vocab_size} cannot shard over {axis}="
+            f"{n_shards} in whole 32-token mask words")
+    v_local = vocab_size // n_shards
+    t = choose_tile(v_local, tile)
+    return n_shards, v_local, t
+
+
+# ------------------------------------------------------------ public API
+
+
+def fused_unembed_sample(tile_logits_fn, vocab_size: int, *, key, temp,
+                         top_k, top_p, rep_pen, seen_words, banned_words,
+                         ban_tok=None, ban_hit=None, greedy: bool = False,
+                         tile: int | None = None,
+                         cand_k: int | None = None) -> jax.Array:
+    """Stream the vocab in tiles and sample without materializing it.
+
+    tile_logits_fn(t0, tile) -> (B, tile) f32 raw logits for tokens
+    [t0, t0+tile) — typically a sliced lm_head projection
+    (models/llama.py ``lm_head_tile``). Returns (B,) int32 tokens with
+    the semantics of ``ops.sampling.sample`` applied to the penalized
+    logits (greedy when ``greedy`` — trace-time, the engine's all-greedy
+    round variant — no noise, no candidate carry, just a running argmax).
+    """
+    tile = choose_tile(vocab_size, tile)
+    cand_k = cand_k or default_cand_k()
+    n_tiles = vocab_size // tile
+    probe = jax.eval_shape(lambda: tile_logits_fn(jnp.int32(0), tile))
+    B = probe.shape[0]
+
+    def masked_tile(t):
+        t0 = (t * tile).astype(jnp.int32)
+        lf = _penalize_tile(
+            tile_logits_fn(t0, tile), t0, tile, seen_words=seen_words,
+            banned_words=banned_words, rep_pen=rep_pen,
+            ban_tok=ban_tok, ban_hit=ban_hit)
+        return t0, lf
+
+    if greedy:
+        _, best_id = _greedy_stream(masked_tile, n_tiles, tile, B)
+        return best_id
+
+    tf = jnp.maximum(temp, 1e-6)[:, None]
+    cv, ci, cp, lse, _, bpid, _, brid = _sample_stream(
+        masked_tile, lambda t: t, key, tf, n_tiles, tile, B, cand_k)
+    return _finalize_sample(cv, ci, cp, lse, bpid, brid, temp=temp,
+                            top_k=top_k, top_p=top_p,
+                            vocab_size=vocab_size, cand_k=cand_k)
+
+
+def fused_unembed_sample_tp(mesh, axis: str, head_tree, head_specs,
+                            local_tile_fn, vocab_size: int, *, hn, key,
+                            temp, top_k, top_p, rep_pen, seen_words,
+                            banned_words, ban_tok=None, ban_hit=None,
+                            greedy: bool = False, tile: int | None = None,
+                            cand_k: int | None = None) -> jax.Array:
+    """:func:`fused_unembed_sample` with the vocab stream SHARDED over
+    the mesh's ``axis``: each chip streams only its local lm_head
+    shard's tiles and the per-shard carries merge with one small
+    cross-chip collective (see module docstring).
+
+    ``head_tree``/``head_specs``: the lm_head (or tied-embedding) leaves
+    and their PartitionSpecs (models/llama.py ``lm_head_subtree`` /
+    ``lm_head_specs``). ``local_tile_fn(head_local, hn, t0, tile)``
+    projects the already-normed hidden rows onto the LOCAL shard's
+    tokens [t0, t0+tile). Noise is keyed on the GLOBAL tile index, so
+    with a matching tile size the sharded stream is sample-exact against
+    the single-chip stream and the materialized oracle. The returned
+    (B,) tokens are replicated on every chip — harvest-safe by
+    construction."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    n_shards, v_local, tile = _shard_geometry(mesh, axis, vocab_size,
+                                              tile)
+    cand_k = cand_k or default_cand_k()
+    n_tiles = v_local // tile
+    B = hn.shape[0]
+    tf = None if greedy else jnp.maximum(temp, 1e-6)[:, None]
+    has_ban = ban_tok is not None
+
+    def shard_fn(head_local, hn, temp, top_k, top_p, rep_pen,
+                 seen_words, banned_words, *ban):
+        idx = jax.lax.axis_index(axis)
+        base = (idx * v_local).astype(jnp.int32)
+        tile_base = idx * n_tiles
+        ban_tok_, ban_hit_ = ban if has_ban else (None, None)
+
+        def masked_tile(t):
+            t0 = base + (t * tile).astype(jnp.int32)   # GLOBAL offset
+            lf = _penalize_tile(
+                local_tile_fn(head_local, hn, (t * tile).astype(jnp.int32),
+                              tile),
+                t0, tile, seen_words=seen_words,
+                banned_words=banned_words, rep_pen=rep_pen,
+                ban_tok=ban_tok_, ban_hit=ban_hit_)
+            return t0, lf
+
+        if greedy:
+            best, best_id = _greedy_stream(masked_tile, n_tiles, tile, B)
+            _, win_id = _merge_running_max(axis, best, best_id)
+            return win_id
+        cv, ci, cp, lse, bpert, bpid, braw, brid = _sample_stream(
+            masked_tile, lambda t: tile_base + t, key, tf, n_tiles, tile,
+            B, cand_k)
+        cv, ci, cp = _merge_candidates(axis, cv, ci, cp, cand_k)
+        lse = _merge_lse(axis, lse)
+        _, bpid = _merge_running_max(axis, bpert, bpid)
+        _, brid = _merge_running_max(axis, braw, brid)
+        return _finalize_sample(cv, ci, cp, lse, bpid, brid, temp=temp,
+                                top_k=top_k, top_p=top_p,
+                                vocab_size=vocab_size, cand_k=cand_k)
+
+    args = (head_tree, hn, temp, top_k, top_p, rep_pen, seen_words,
+            banned_words) + ((ban_tok, ban_hit) if has_ban else ())
+    in_specs = (head_specs,) + (P(),) * (len(args) - 1)
+    return shard_map(shard_fn, mesh=mesh, in_specs=in_specs,
+                     out_specs=P(), check_rep=False)(*args)
 
 
 def fused_verify_sample(tile_logits_fn, vocab_size: int, *, key, u, temp,
@@ -261,96 +556,86 @@ def fused_verify_sample(tile_logits_fn, vocab_size: int, *, key, u, temp,
     R = probe.shape[0]
     tf = jnp.maximum(temp, 1e-6)[:, None]
 
-    def body(carry, t):
-        (cv, ci, cp, lse, braw, brid, sd, sfound, npert, npid) = carry
+    def masked_tile(t):
         t0 = (t * tile).astype(jnp.int32)
         lf = _penalize_tile(
             tile_logits_fn(t0, tile), t0, tile, seen_words=seen_words,
             banned_words=banned_words, rep_pen=rep_pen,
             ban_tok=ban_tok, ban_hit=ban_hit)
-        ids = t0 + jnp.arange(tile, dtype=jnp.int32)
-        idb = jnp.broadcast_to(ids, lf.shape)
-        scaled = lf / tf
-        g = jax.random.gumbel(jax.random.fold_in(key, t),
-                              (R, tile), jnp.float32)
-        pert = scaled + g
-        lse = jnp.logaddexp(lse, jax.nn.logsumexp(scaled, axis=-1))
-        # running greedy argmax (greedy rows + the greedy accept test)
-        rb = jnp.max(lf, axis=-1)
-        ri = jnp.take_along_axis(idb, jnp.argmax(lf, -1)[:, None],
-                                 axis=1)[:, 0]
-        ug = rb > braw
-        braw, brid = jnp.where(ug, rb, braw), jnp.where(ug, ri, brid)
-        # the draft token's scaled logit (each id lives in exactly one
-        # tile, so a masked sum is a gather)
-        dm = idb == draft_ids[:, None]
-        sd = sd + jnp.sum(jnp.where(dm, scaled, 0.0), axis=-1)
-        sfound = sfound | jnp.any(dm, axis=-1)
-        # running Gumbel-argmax with the draft masked: the UNTRUNCATED
-        # residual sample (draft -1 matches nothing -> plain sample)
-        pert_nod = jnp.where(dm, -jnp.inf, pert)
-        nb = jnp.max(pert_nod, axis=-1)
-        ni = jnp.take_along_axis(idb, jnp.argmax(pert_nod, -1)[:, None],
-                                 axis=1)[:, 0]
-        un = nb > npert
-        npert, npid = jnp.where(un, nb, npert), jnp.where(un, ni, npid)
-        # candidate merge (identical to fused_unembed_sample: carry-first
-        # preserves the oracle's stable tie order)
-        av = jnp.concatenate([cv, scaled], axis=-1)
-        ai = jnp.concatenate([ci, idb], axis=-1)
-        ap = jnp.concatenate([cp, pert], axis=-1)
-        cv, sel = jax.lax.top_k(av, cand_k)
-        ci = jnp.take_along_axis(ai, sel, axis=-1)
-        cp = jnp.take_along_axis(ap, sel, axis=-1)
-        return (cv, ci, cp, lse, braw, brid, sd, sfound, npert, npid), None
+        return t0, lf
 
-    init = (jnp.full((R, cand_k), -jnp.inf, jnp.float32),
-            jnp.zeros((R, cand_k), jnp.int32),
-            jnp.full((R, cand_k), -jnp.inf, jnp.float32),
-            jnp.full((R,), -jnp.inf, jnp.float32),
-            jnp.full((R,), -jnp.inf, jnp.float32),
-            jnp.zeros((R,), jnp.int32),
-            jnp.zeros((R,), jnp.float32),
-            jnp.zeros((R,), bool),
-            jnp.full((R,), -jnp.inf, jnp.float32),
-            jnp.zeros((R,), jnp.int32))
-    (cv, ci, cp, lse, _, brid, sd, sfound, _, npid), _ = jax.lax.scan(
-        body, init, jnp.arange(n_tiles, dtype=jnp.int32))
-    sd = jnp.where(sfound, sd, -jnp.inf)
+    (cv, ci, cp, lse, _, brid, sd, sfound, _, npid) = _verify_stream(
+        masked_tile, lambda t: t, key, tf, draft_ids, n_tiles, tile, R,
+        cand_k)
+    return _finalize_verify(cv, ci, cp, lse, brid, sd, sfound, npid,
+                            u=u, temp=temp, top_k=top_k, top_p=top_p,
+                            draft_ids=draft_ids, vocab_size=vocab_size,
+                            cand_k=cand_k)
 
-    V = vocab_size
-    kk = jnp.where(top_k <= 0, V, top_k)
-    p = jnp.where((top_p <= 0) | (top_p >= 1.0), 1.0, top_p)
-    probs = jnp.exp(cv - lse[:, None])
-    cum_before = jnp.cumsum(probs, axis=-1) - probs
-    keep = ((jnp.arange(cand_k)[None, :] < kk[:, None])
-            & (cum_before < p[:, None]))
-    # Truncated target: normalizer over the KEPT candidates only; the
-    # draft's probability is exp(scaled_d - Z_kept) when the draft made
-    # the kept set, else exactly 0.
-    z_kept = jax.nn.logsumexp(jnp.where(keep, cv, -jnp.inf), axis=-1)
-    is_draft = ci == draft_ids[:, None]
-    draft_kept = jnp.any(is_draft & keep, axis=-1)
-    p_trunc = jnp.where(draft_kept, jnp.exp(sd - z_kept), 0.0)
-    # Truncated residual: Gumbel-argmax over kept candidates minus the
-    # draft.  A kept set of exactly {draft} has an empty residual — but
-    # then p(draft) == 1 and the residual is never consumed; fall back
-    # to the draft itself so a float-rounded reject can't emit ci[0].
-    kept_res = keep & ~is_draft
-    res_pert = jnp.where(kept_res, cp, -jnp.inf)
-    trunc_res = jnp.take_along_axis(
-        ci, jnp.argmax(res_pert, -1)[:, None], axis=1)[:, 0]
-    trunc_res = jnp.where(jnp.any(kept_res, axis=-1), trunc_res,
-                          draft_ids)
-    untruncated = (kk >= V) & (p >= 1.0)
-    p_acc = jnp.where(untruncated, jnp.exp(sd - lse), p_trunc)
-    resample = jnp.where(untruncated, npid, trunc_res)
-    accept = u < p_acc
-    out_tok = resample.astype(jnp.int32)
-    is_greedy = (temp <= 0) | (top_k == 1)
-    accept = jnp.where(is_greedy, draft_ids == brid, accept)
-    out_tok = jnp.where(is_greedy, brid, out_tok)
-    return accept, out_tok
+
+def fused_verify_sample_tp(mesh, axis: str, head_tree, head_specs,
+                           local_tile_fn, vocab_size: int, *, hn, key, u,
+                           temp, top_k, top_p, rep_pen, seen_words,
+                           banned_words, draft_ids, ban_tok=None,
+                           ban_hit=None, tile: int | None = None,
+                           cand_k: int | None = None
+                           ) -> tuple[jax.Array, jax.Array]:
+    """:func:`fused_verify_sample` with the vocab stream sharded over
+    ``axis`` — the speculative verify tail for tp-sharded serving. Same
+    per-shard stream + one-merge structure as
+    :func:`fused_unembed_sample_tp`; the draft token's scaled logit
+    lives on exactly one shard, so its gather is a ``psum`` over zeros
+    elsewhere. Verdicts come back replicated on every chip."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    n_shards, v_local, tile = _shard_geometry(mesh, axis, vocab_size,
+                                              tile)
+    cand_k = cand_k or default_cand_k()
+    n_tiles = v_local // tile
+    R = hn.shape[0]
+    tf = jnp.maximum(temp, 1e-6)[:, None]
+    has_ban = ban_tok is not None
+
+    def shard_fn(head_local, hn, u, temp, top_k, top_p, rep_pen,
+                 seen_words, banned_words, draft_ids, *ban):
+        idx = jax.lax.axis_index(axis)
+        base = (idx * v_local).astype(jnp.int32)
+        tile_base = idx * n_tiles
+        ban_tok_, ban_hit_ = ban if has_ban else (None, None)
+
+        def masked_tile(t):
+            t0 = base + (t * tile).astype(jnp.int32)
+            lf = _penalize_tile(
+                local_tile_fn(head_local, hn, (t * tile).astype(jnp.int32),
+                              tile),
+                t0, tile, seen_words=seen_words,
+                banned_words=banned_words, rep_pen=rep_pen,
+                ban_tok=ban_tok_, ban_hit=ban_hit_)
+            return t0, lf
+
+        (cv, ci, cp, lse, braw, brid, sd, sfound, npert, npid) = \
+            _verify_stream(masked_tile, lambda t: tile_base + t, key, tf,
+                           draft_ids, n_tiles, tile, R, cand_k)
+        cv, ci, cp = _merge_candidates(axis, cv, ci, cp, cand_k)
+        lse = _merge_lse(axis, lse)
+        _, brid = _merge_running_max(axis, braw, brid)
+        _, npid = _merge_running_max(axis, npert, npid)
+        # sd accumulated only on the shard owning the draft id (zeros
+        # elsewhere); sfound likewise — one psum each completes them.
+        sd = jax.lax.psum(sd, axis)
+        sfound = jax.lax.psum(sfound.astype(jnp.int32), axis) > 0
+        return _finalize_verify(cv, ci, cp, lse, brid, sd, sfound, npid,
+                                u=u, temp=temp, top_k=top_k, top_p=top_p,
+                                draft_ids=draft_ids,
+                                vocab_size=vocab_size, cand_k=cand_k)
+
+    args = (head_tree, hn, u, temp, top_k, top_p, rep_pen, seen_words,
+            banned_words, draft_ids) + ((ban_tok, ban_hit) if has_ban
+                                        else ())
+    in_specs = (head_specs,) + (P(),) * (len(args) - 1)
+    return shard_map(shard_fn, mesh=mesh, in_specs=in_specs,
+                     out_specs=(P(), P()), check_rep=False)(*args)
 
 
 def verify_reference_tiled(logits, key, u, temp, top_k, top_p, draft_ids,
@@ -361,8 +646,8 @@ def verify_reference_tiled(logits, key, u, temp, top_k, top_p, draft_ids,
     fused path must produce IDENTICAL verdicts for the same key
     whenever the kept prefix fits its candidate carry (tier-1 pinned).
     Also the verification tail for the engine's materialized
-    (non-fused) decode path under ``ENGINE_FUSED_SAMPLER=0`` / mesh
-    serving."""
+    (non-fused) decode path under ``ENGINE_FUSED_SAMPLER=0`` or a
+    downgraded mesh geometry."""
     R, V = logits.shape
     lf = logits.astype(jnp.float32)
     greedy_ids = jnp.argmax(lf, axis=-1).astype(jnp.int32)

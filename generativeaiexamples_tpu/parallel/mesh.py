@@ -22,6 +22,30 @@ from ..utils.errors import ShardingError
 # Canonical mesh axes: data, pipeline, expert, sequence, tensor.
 AXES = ("dp", "pp", "ep", "sp", "tp")
 
+
+def parse_mesh_spec(spec: str) -> dict:
+    """``"tp=2"`` / ``"tp=2,sp=2"`` -> ``{"tp": 2, "sp": 2}``. The one
+    grammar for every mesh-spec surface (``BENCH_MESH`` rungs,
+    ``tools/profile_decode.py --mesh``): unknown axes and non-positive
+    sizes are a loud ``ValueError`` — a typo'd axis would otherwise
+    silently measure or serve a topology the caller never asked for."""
+    axes: dict = {}
+    for part in (p.strip() for p in spec.split(",") if p.strip()):
+        axis, sep, n = part.partition("=")
+        axis = axis.strip()
+        if not sep or axis not in AXES:
+            raise ValueError(f"mesh spec {spec!r}: want axis=N pairs "
+                             f"over {AXES}")
+        if axis in axes:
+            raise ValueError(f"mesh spec {spec!r}: axis {axis} given "
+                             f"twice")
+        size = int(n)
+        if size < 1:
+            raise ValueError(f"mesh spec {spec!r}: axis {axis} size "
+                             f"must be >= 1")
+        axes[axis] = size
+    return axes
+
 _distributed_initialized = False
 
 

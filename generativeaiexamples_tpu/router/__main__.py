@@ -156,6 +156,7 @@ def main(argv: Optional[list[str]] = None) -> int:
 
     from aiohttp import web
 
+    from ..utils.logging import write_pid_file
     from .server import create_router_app
 
     try:
@@ -163,6 +164,11 @@ def main(argv: Optional[list[str]] = None) -> int:
     except ValueError as exc:
         print(f"serve: {exc}", file=sys.stderr)
         return 2
+    # Pid file under the run dir ($GAIE_RUN_DIR) like both servers —
+    # launcher lines used to `echo $! > router.pid` at the repo root
+    # (PR-10 rehomed the servers' pids; the router missed). Logs go to
+    # stderr; redirect them under $GAIE_RUN_DIR too, never the repo.
+    write_pid_file(f"router-{args.port}")
     if not replicas:
         print("serve: --replicas (or ROUTER_REPLICAS) is required",
               file=sys.stderr)

@@ -1,0 +1,168 @@
+"""Tier-1 CPU smoke of the multi-chip serving sweep (``BENCH_MESH``):
+tp=1 and tp=2 rungs end-to-end through real engines on the virtual
+8-device host platform, the section/rung key contract against
+tools/bench_schema.json, and the ACCEPTANCE-criterion scheduling fact:
+each rung's round budget is derived from the topology-MATCHED cost row,
+so tp=1 and tp=2 budgets differ when the profile rows differ."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+import bench
+from generativeaiexamples_tpu.models import llama
+from generativeaiexamples_tpu.models.configs import LlamaConfig
+from generativeaiexamples_tpu.models.tokenizer import ByteTokenizer
+from tools.check_bench_schema import load_schema, validate_result
+from tools.preflight import validate_multichip_block
+
+# vocab 320 = 2 x 160 (whole 32-token mask words per tp=2 shard); heads
+# divide tp=2 so the geometry serves the SHARDED fused tail, not a
+# downgrade.
+CFG = LlamaConfig(vocab_size=320, hidden_size=64, intermediate_size=128,
+                  num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+                  max_position_embeddings=256)
+
+# Topology-keyed cost artifact: the tp=2 row models prefill 2x cheaper,
+# so its derived round budget (decode-round ms / prefill ms-per-token)
+# is exactly 2x the single-chip row's — the budgets MUST differ.
+PROFILE = {
+    "full_ms_per_step": 2.0, "prefill_ms_per_token": 0.125, "slots": 8,
+    "topologies": {"tp=2": {"prefill_ms_per_token": 0.0625}},
+}
+
+
+@pytest.fixture(scope="module")
+def multichip(tmp_path_factory):
+    path = tmp_path_factory.mktemp("prof") / "PROFILE_topo.json"
+    path.write_text(json.dumps(PROFILE))
+    old = os.environ.get("SCHED_PROFILE_JSON")
+    os.environ["SCHED_PROFILE_JSON"] = str(path)
+    try:
+        params = llama.init_params(CFG, jax.random.key(11),
+                                   dtype=jnp.float32)
+        return bench.run_multichip_sweep(
+            params, CFG, ByteTokenizer(), ["tp=1", "tp=2"],
+            prompt_len=16, out_len=4, n_requests=2, slots=2,
+            steps_per_round=4,
+            # tiny-geometry overrides (production defaults target the
+            # chip)
+            max_input_length=64, max_output_length=16,
+            prefill_buckets=(16, 32, 64), dtype="float32", page_size=16,
+            max_queue=64)
+    finally:
+        if old is None:
+            os.environ.pop("SCHED_PROFILE_JSON", None)
+        else:
+            os.environ["SCHED_PROFILE_JSON"] = old
+
+
+def test_mesh_rung_parsing_contracts():
+    """BENCH_MESH parsing: ';' always separates rungs; without one a
+    comma starts a new rung only on a repeated axis (a mesh never
+    repeats an axis), and unknown axes fail LOUDLY before any engine
+    is built."""
+    assert bench.split_mesh_rungs("tp=1,tp=2,tp=4") == \
+        ["tp=1", "tp=2", "tp=4"]
+    assert bench.split_mesh_rungs("tp=2,sp=2") == ["tp=2,sp=2"]
+    assert bench.split_mesh_rungs("tp=2,sp=2;tp=4") == \
+        ["tp=2,sp=2", "tp=4"]
+    assert bench.split_mesh_rungs("tp=1,tp=2,sp=2") == \
+        ["tp=1", "tp=2,sp=2"]
+    label, axes, devices = bench.parse_mesh_rung("sp=2,tp=2")
+    assert (label, devices) == ("sp=2,tp=2", 4)
+    assert axes == {"sp": 2, "tp": 2}
+    with pytest.raises(ValueError, match="axis=N"):
+        bench.parse_mesh_rung("tpx=4")
+    with pytest.raises(ValueError, match="twice"):
+        bench.parse_mesh_rung("tp=2,tp=4")
+    with pytest.raises(ValueError):
+        bench.run_multichip_sweep(
+            None, CFG, None, ["tp=2", "bogus=2"], prompt_len=8,
+            out_len=4, n_requests=1)
+
+
+def test_multichip_sweep_runs_every_rung(multichip):
+    assert multichip["mesh_sweep"] == ["tp=1", "tp=2"]
+    assert [r["mesh"] for r in multichip["rungs"]] == ["tp=1", "tp=2"]
+    assert [r["devices"] for r in multichip["rungs"]] == [1, 2]
+    for rung in multichip["rungs"]:
+        assert rung["decode_tokens_per_sec"] > 0
+        assert rung["engine_p50_ttft_ms"] > 0
+        assert rung["tokens_per_sec_per_device"] == pytest.approx(
+            rung["decode_tokens_per_sec"] / rung["devices"], rel=0.02)
+        assert rung["engine_downgrades"] == 0
+
+
+def test_multichip_mesh_rung_serves_sharded_fused_tail(multichip):
+    """The tentpole's point: a mesh rung reads ``fused_tp``, never the
+    PR-8 "mesh keeps the materialized tail" fallback."""
+    by_mesh = {r["mesh"]: r for r in multichip["rungs"]}
+    assert by_mesh["tp=1"]["tail"] == "fused"
+    assert by_mesh["tp=2"]["tail"] == "fused_tp"
+
+
+def test_multichip_budget_from_topology_matched_row(multichip):
+    """Acceptance criterion: the round budget each rung's scheduler
+    started from is derived from the topology-MATCHED cost row —
+    budgets differ between tp=1 and tp=2 because the profile rows do,
+    and each rung names the row it used."""
+    by_mesh = {r["mesh"]: r for r in multichip["rungs"]}
+    b1 = by_mesh["tp=1"]["sched_round_budget_tokens"]
+    b2 = by_mesh["tp=2"]["sched_round_budget_tokens"]
+    assert b1 > 0 and b2 > 0
+    # tp=2 prefill modeled 2x cheaper -> 2x the budget (page-quantized;
+    # budget = decode_round_ms / prefill_ms_per_token)
+    assert b2 == 2 * b1, (b1, b2)
+    assert by_mesh["tp=1"]["cost_topology"] == "tp=1"
+    assert by_mesh["tp=2"]["cost_topology"] == "tp=2"
+    assert by_mesh["tp=2"]["cost_source"].endswith("@tp=2")
+
+
+def test_multichip_section_keys_pinned_by_schema(multichip):
+    """The emitted section IS the schema's multichip/multichip_rung
+    contract — renaming either side alone fails (same enforcement as
+    capacity_rung / fleet_policy)."""
+    schema = load_schema()
+    assert set(multichip) == set(schema["multichip"])
+    for rung in multichip["rungs"]:
+        assert set(rung) == set(schema["multichip_rung"])
+    # the full result path accepts it too
+    result = bench.assemble_result(
+        kind="engine", model="t", headline=1.0, engine_p50=1.0,
+        engine_p99=1.0, tput=1.0, achieved_bw=1.0, bw_util=0.1,
+        bw_steady=True, chat=None, e2e_p50=None, e2e_dist=None,
+        e2e_breakdown=None, pipeline=bench.pipeline_snapshot({}),
+        quant="none", kv_quant=None, weights="random-init",
+        prompt_len=16, out_len=4, slots=2, steps_per_round=4,
+        kv_pool_pages=8, device="cpu", rtt_ms=None, n_devices=8,
+        bench_seconds=1.0, multichip=multichip)
+    validate_result(result)
+
+
+def test_multichip_preflight_validator_accepts_real_sweep(multichip):
+    assert validate_multichip_block(multichip) == []
+
+
+def test_multichip_preflight_validator_can_fail(multichip):
+    """The preflight ``multichip`` check is proven able to fail: a mesh
+    rung that silently regressed to the materialized tail, a
+    devices/mesh mismatch, and a zero budget are each caught."""
+    import copy
+
+    broken = copy.deepcopy(multichip)
+    broken["rungs"][1]["tail"] = "materialized"
+    assert any("regressed" in e for e in validate_multichip_block(broken))
+    broken = copy.deepcopy(multichip)
+    broken["rungs"][1]["devices"] = 3
+    assert any("axis product" in e
+               for e in validate_multichip_block(broken))
+    broken = copy.deepcopy(multichip)
+    broken["rungs"][0]["sched_round_budget_tokens"] = 0
+    assert any("budget" in e for e in validate_multichip_block(broken))
+    broken = copy.deepcopy(multichip)
+    del broken["rungs"][0]["decode_tokens_per_sec"]
+    assert validate_multichip_block(broken)

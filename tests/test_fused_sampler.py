@@ -278,3 +278,199 @@ def test_lm_head_tile_matches_full_unembed(storage):
          for t0 in range(0, V, tile)], axis=-1)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-5, atol=1e-5)
+
+
+# ------------------------------------------- tp-sharded tile stream
+
+
+def _tp_setup(B, tp=2, seed=3):
+    """Shared fixture pieces for the sharded-stream parity tests: a tp
+    mesh over the virtual CPU devices, penalization state, and the
+    matched tile size (single-chip stream pinned to the sharded tile so
+    both consume the SAME global Gumbel field)."""
+    from generativeaiexamples_tpu.parallel import MeshPlan, make_mesh
+
+    mesh = make_mesh(MeshPlan(tp=tp), jax.devices()[:tp])
+    logits, seen, banned, key = _mk(B, seed=seed)
+    tile = choose_tile(V // tp)
+    return mesh, logits, seen, banned, key, tile
+
+
+def _raw_local_tile_fn(head_key):
+    def f(head_local, hn, t0, tile):
+        sl = jax.lax.dynamic_slice_in_dim(head_local[head_key], t0,
+                                          tile, axis=1)
+        return jax.lax.dot_general(
+            hn, sl, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    return f
+
+
+def test_sharded_sample_exact_vs_single_chip_and_oracle():
+    """fused_unembed_sample_tp is SAMPLE-EXACT against both the
+    single-chip stream (same tile size => same noise) and the
+    materialized oracle — greedy rows, truncated rows, untruncated rows
+    — with the per-shard carries merged across the tp axis."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from generativeaiexamples_tpu.ops.fused_sampler import (
+        fused_unembed_sample_tp)
+
+    B = 5
+    mesh, logits, seen, banned, key, tile = _tp_setup(B)
+    temp = jnp.asarray([0.8, 1.3, 0.0, 1.0, 0.9], jnp.float32)
+    top_k = jnp.asarray([0, 5, 1, 0, 16], jnp.int32)
+    top_p = jnp.asarray([0.0, 0.0, 0.0, 0.9, 0.8], jnp.float32)
+    rep = jnp.full((B,), 1.15, jnp.float32)
+    seen_w, ban_w = pack_mask(seen), pack_mask(banned)
+    # identity "projection": hn IS the logits, the head the identity —
+    # isolates the stream/merge math from any matmul
+    eye = jax.device_put(jnp.eye(V, dtype=jnp.float32),
+                         NamedSharding(mesh, P(None, "tp")))
+
+    ref = fused_unembed_sample(_tile_fn(logits), V, key=key, temp=temp,
+                               top_k=top_k, top_p=top_p, rep_pen=rep,
+                               seen_words=seen_w, banned_words=ban_w,
+                               tile=tile)
+    got = jax.jit(lambda hd, h: fused_unembed_sample_tp(
+        mesh, "tp", {"lm_head": hd}, {"lm_head": P(None, "tp")},
+        _raw_local_tile_fn("lm_head"), V, hn=h, key=key, temp=temp,
+        top_k=top_k, top_p=top_p, rep_pen=rep, seen_words=seen_w,
+        banned_words=ban_w))(eye, logits)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+    oracle = sample_reference_tiled(
+        _oracle_penalize(logits, seen, banned, rep), key, temp, top_k,
+        top_p, tile=tile)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(oracle))
+
+    # greedy variant: running-argmax merge, lowest-shard tie rule
+    g_ref = fused_unembed_sample(_tile_fn(logits), V, key=key, temp=temp,
+                                 top_k=top_k, top_p=top_p, rep_pen=rep,
+                                 seen_words=seen_w, banned_words=ban_w,
+                                 greedy=True, tile=tile)
+    g_got = jax.jit(lambda hd, h: fused_unembed_sample_tp(
+        mesh, "tp", {"lm_head": hd}, {"lm_head": P(None, "tp")},
+        _raw_local_tile_fn("lm_head"), V, hn=h, key=key, temp=temp,
+        top_k=top_k, top_p=top_p, rep_pen=rep, seen_words=seen_w,
+        banned_words=ban_w, greedy=True))(eye, logits)
+    np.testing.assert_array_equal(np.asarray(g_got), np.asarray(g_ref))
+
+
+def test_sharded_verify_verdict_exact_vs_oracle():
+    """fused_verify_sample_tp produces IDENTICAL accept/resample
+    verdicts to the materialized oracle under a fixed key/uniforms —
+    the draft's scaled logit crossing shards via psum, the residual
+    Gumbel-argmax via the running-max merge."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from generativeaiexamples_tpu.ops.fused_sampler import (
+        fused_verify_sample, fused_verify_sample_tp,
+        verify_reference_tiled)
+
+    R = 6
+    mesh, logits, seen, banned, key, tile = _tp_setup(R, seed=5)
+    temp = jnp.asarray([0.9, 1.1, 0.0, 1.0, 0.8, 1.2], jnp.float32)
+    top_k = jnp.asarray([0, 6, 1, 0, 12, 0], jnp.int32)
+    top_p = jnp.asarray([0.0, 0.0, 0.0, 0.9, 0.0, 0.85], jnp.float32)
+    rep = jnp.full((R,), 1.1, jnp.float32)
+    # drafts on BOTH shards' vocab halves, plus a -1 bonus row
+    drafts = jnp.asarray([3, 100, 64, -1, 127, 40], jnp.int32)
+    u = jax.random.uniform(jax.random.key(17), (R,))
+    seen_w, ban_w = pack_mask(seen), pack_mask(banned)
+    eye = jax.device_put(jnp.eye(V, dtype=jnp.float32),
+                         NamedSharding(mesh, P(None, "tp")))
+
+    a_ref, o_ref = fused_verify_sample(
+        _tile_fn(logits), V, key=key, u=u, temp=temp, top_k=top_k,
+        top_p=top_p, rep_pen=rep, seen_words=seen_w, banned_words=ban_w,
+        draft_ids=drafts, tile=tile)
+    a_got, o_got = jax.jit(lambda hd, h: fused_verify_sample_tp(
+        mesh, "tp", {"lm_head": hd}, {"lm_head": P(None, "tp")},
+        _raw_local_tile_fn("lm_head"), V, hn=h, key=key, u=u, temp=temp,
+        top_k=top_k, top_p=top_p, rep_pen=rep, seen_words=seen_w,
+        banned_words=ban_w, draft_ids=drafts))(eye, logits)
+    np.testing.assert_array_equal(np.asarray(a_got), np.asarray(a_ref))
+    np.testing.assert_array_equal(np.asarray(o_got), np.asarray(o_ref))
+
+    a_orc, o_orc = verify_reference_tiled(
+        _oracle_penalize(logits, seen, banned, rep), key, u, temp,
+        top_k, top_p, drafts, tile=tile)
+    np.testing.assert_array_equal(np.asarray(a_got), np.asarray(a_orc))
+    np.testing.assert_array_equal(np.asarray(o_got), np.asarray(o_orc))
+
+
+@pytest.mark.parametrize("storage", ["raw", "tied", "int8", "int4",
+                                     "int4_grouped"])
+def test_sharded_head_storage_parity(storage):
+    """The sharded tail serves EVERY lm_head storage: the local shard of
+    a tied embedding / raw head / quantized dict (placed per
+    llama.lm_head_specs) projects its vocab half exactly like the
+    single-chip tile stream projects the same global range — pinned by
+    greedy token equality against the single-chip fused sampler."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from generativeaiexamples_tpu.models import llama
+    from generativeaiexamples_tpu.models.configs import LlamaConfig
+    from generativeaiexamples_tpu.ops.fused_sampler import (
+        fused_unembed_sample_tp)
+    from generativeaiexamples_tpu.ops.quant import (quantize_tensor,
+                                                    quantize_tensor_grouped)
+    from generativeaiexamples_tpu.parallel import MeshPlan, make_mesh
+
+    cfg = LlamaConfig(vocab_size=V, hidden_size=64, intermediate_size=128,
+                      num_layers=1, num_heads=4, num_kv_heads=2,
+                      head_dim=16, max_position_embeddings=64)
+    params = llama.init_params(cfg, jax.random.key(6), dtype=jnp.float32)
+    if storage == "tied":
+        params = {k: v for k, v in params.items() if k != "lm_head"}
+    elif storage != "raw":
+        head = params["lm_head"]
+        params = dict(params)
+        if storage == "int8":
+            params["lm_head"] = quantize_tensor(head, bits=8)
+        elif storage == "int4":
+            params["lm_head"] = quantize_tensor(head, bits=4)
+        else:
+            params["lm_head"] = quantize_tensor_grouped(head,
+                                                        group_size=32)
+    B = 3
+    mesh = make_mesh(MeshPlan(tp=2), jax.devices()[:2])
+    hn = jax.random.normal(jax.random.key(8), (B, 64), jnp.float32)
+    _, seen, banned, key = _mk(B, seed=9)
+    seen_w, ban_w = pack_mask(seen), pack_mask(banned)
+    temp = jnp.zeros((B,), jnp.float32)       # greedy rows
+    top_k = jnp.ones((B,), jnp.int32)
+    top_p = jnp.zeros((B,), jnp.float32)
+    rep = jnp.full((B,), 1.2, jnp.float32)
+    tile = choose_tile(V // 2)
+
+    ref = fused_unembed_sample(
+        lambda t0, t: llama.lm_head_tile(params, cfg, hn, t0, t), V,
+        key=key, temp=temp, top_k=top_k, top_p=top_p, rep_pen=rep,
+        seen_words=seen_w, banned_words=ban_w, greedy=True, tile=tile)
+
+    subtree = llama.lm_head_subtree(params)
+    specs = llama.lm_head_specs(params, mesh)
+    placed = jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        subtree, specs)
+    got = jax.jit(lambda head, h: fused_unembed_sample_tp(
+        mesh, "tp", head, specs,
+        lambda head_local, rows, t0, t: llama.lm_head_tile(
+            head_local, cfg, rows, t0, t),
+        V, hn=h, key=key, temp=temp, top_k=top_k, top_p=top_p,
+        rep_pen=rep, seen_words=seen_w, banned_words=ban_w,
+        greedy=True))(placed, hn)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_tp_shardable_geometry_rule():
+    from generativeaiexamples_tpu.ops.fused_sampler import tp_shardable
+
+    assert tp_shardable(320, 2)          # 160-token shards, whole words
+    assert tp_shardable(128, 4)          # 32-token shards
+    assert not tp_shardable(320, 4)      # 80 % 32 != 0
+    assert not tp_shardable(130, 2)      # 65 % 32 != 0
+    assert not tp_shardable(320, 3)      # uneven split
+    assert not tp_shardable(320, 1)      # single chip: not a tp stream

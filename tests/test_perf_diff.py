@@ -102,6 +102,30 @@ def test_extract_autoscale_policy_metrics_direction_aware():
                for r in regressions)
 
 
+def test_extract_multichip_rung_metrics_direction_aware():
+    """Multichip rungs contribute per-mesh gates (ISSUE 14): tokens/s
+    is gated UP and TTFT DOWN per rung, so a tp=2 rung that quietly
+    slowed to single-chip speed regresses the gate even when the tp=1
+    rung held."""
+    result = _result(multichip={"rungs": [
+        {"mesh": "tp=1", "decode_tokens_per_sec": 500.0,
+         "engine_p50_ttft_ms": 150.0},
+        {"mesh": "tp=2", "decode_tokens_per_sec": 900.0,
+         "engine_p50_ttft_ms": 95.0},
+    ]})
+    m = extract_metrics(result)
+    assert m["multichip.tokens_per_sec@tp=2"] == (900.0, "higher")
+    assert m["multichip.ttft_p50_ms@tp=2"] == (95.0, "lower")
+    assert m["multichip.tokens_per_sec@tp=1"] == (500.0, "higher")
+    worse = extract_metrics(_result(multichip={"rungs": [
+        {"mesh": "tp=2", "decode_tokens_per_sec": 500.0,
+         "engine_p50_ttft_ms": 150.0},
+    ]}))
+    regressions, _ = compare(m, worse)
+    assert any("multichip.tokens_per_sec@tp=2" in r for r in regressions)
+    assert any("multichip.ttft_p50_ms@tp=2" in r for r in regressions)
+
+
 def test_extract_tolerates_missing_sections():
     m = extract_metrics({"decode_tokens_per_sec": 100.0, "chat": {}})
     assert set(m) == {"decode_tokens_per_sec"}

@@ -25,6 +25,9 @@ SHARED_KEYS = {
     # geometry of the per-rung speculative-verify measurement (the S in
     # each rung's S-position verify_ms_per_step)
     "verify_positions",
+    # topology row key (engine/scheduler.py topology_key): which mesh
+    # shape these costs were measured at ("tp=1" = single chip)
+    "topology", "mesh_devices",
 }
 
 RUNG_KEYS = {

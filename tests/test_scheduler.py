@@ -65,6 +65,79 @@ def test_load_falls_back_to_defaults(tmp_path, monkeypatch):
     assert m.decode_step_ms > 0 and m.prefill_ms_per_token > 0
 
 
+# --------------------------------------------------- topology-keyed rows
+
+
+def test_topology_key_canonicalization():
+    from generativeaiexamples_tpu.engine.scheduler import topology_key
+
+    assert topology_key(None) == "tp=1"
+    assert topology_key({}) == "tp=1"
+    # trivial axes drop; non-trivial ones sort, so one canonical label
+    # per mesh shape however the dict was built
+    assert topology_key({"dp": 1, "pp": 1, "tp": 2}) == "tp=2"
+    assert topology_key({"tp": 2, "sp": 4}) == "sp=4,tp=2"
+    assert topology_key({"dp": 1, "sp": 1, "tp": 1}) == "tp=1"
+
+
+def test_load_matches_topology_row(tmp_path, monkeypatch):
+    """Topology precedence (docs/scheduler.md): an artifact's own label
+    (absent == tp=1) or a ``topologies`` row matching the engine's mesh
+    wins; the row's keys override the shared fields; with no matching
+    row anywhere the newest parseable artifact is used as-is."""
+    import json
+
+    art = tmp_path / "PROFILE_topo.json"
+    art.write_text(json.dumps({
+        "full_ms_per_step": 2.0, "prefill_ms_per_token": 0.25,
+        "slots": 8,
+        "topologies": {"tp=2": {"full_ms_per_step": 1.5,
+                                "prefill_ms_per_token": 0.125}},
+    }))
+    monkeypatch.setenv("SCHED_PROFILE_JSON", str(art))
+
+    single = StepCostModel.load(topology="tp=1")
+    assert single.decode_step_ms == 2.0
+    assert single.topology == "tp=1"
+
+    tp2 = StepCostModel.load(topology="tp=2")
+    assert tp2.decode_step_ms == 1.5
+    assert tp2.prefill_ms_per_token == 0.125
+    assert tp2.topology == "tp=2"
+    assert tp2.source.endswith("@tp=2")
+    # the budgets the two rows derive DIFFER — the acceptance-criterion
+    # fact the multichip bench pins end-to-end
+    assert derive_round_budget(tp2, 4, PAGE) != \
+        derive_round_budget(single, 4, PAGE)
+
+    # no matching row: the artifact still beats built-in defaults, and
+    # its topology field records the mismatch (tp=1 measurement)
+    tp4 = StepCostModel.load(topology="tp=4")
+    assert tp4.decode_step_ms == 2.0 and tp4.topology == "tp=1"
+
+
+def test_load_artifact_own_topology_label(tmp_path, monkeypatch):
+    """A --mesh-generated artifact (topology stamped at top level) is
+    matched by label; a tp=1 engine skips it in favor of an untagged
+    (single-chip) artifact even when the tagged one sorts newer."""
+    import json
+
+    (tmp_path / "PROFILE_r98.json").write_text(json.dumps({
+        "full_ms_per_step": 3.0, "prefill_ms_per_token": 0.3,
+        "slots": 8}))
+    (tmp_path / "PROFILE_r99.json").write_text(json.dumps({
+        "full_ms_per_step": 1.0, "prefill_ms_per_token": 0.1,
+        "slots": 8, "topology": "tp=2"}))
+    monkeypatch.chdir(tmp_path)
+    import generativeaiexamples_tpu.engine.scheduler as sched
+    monkeypatch.setattr(sched, "_REPO_ROOT", str(tmp_path))
+
+    tp2 = StepCostModel.load(topology="tp=2")
+    assert tp2.decode_step_ms == 1.0 and tp2.topology == "tp=2"
+    single = StepCostModel.load(topology="tp=1")
+    assert single.decode_step_ms == 3.0 and single.topology == "tp=1"
+
+
 # ------------------------------------------------------ budget packing
 
 

@@ -76,7 +76,7 @@ def validate_result(result: dict, schema: dict | None = None) -> None:
     errors: list[str] = []
     _check_types("result", result, schema["top_level"], errors)
     for section in ("engine_pipeline", "engine_rounds", "e2e_ttft_dist_ms",
-                    "chat", "openloop", "fleet", "capacity",
+                    "chat", "openloop", "fleet", "capacity", "multichip",
                     "kv_pressure", "autoscale"):
         sub = result.get(section)
         if isinstance(sub, dict):
@@ -150,6 +150,26 @@ def validate_result(result: dict, schema: dict | None = None) -> None:
                 else:
                     errors.append(
                         f"capacity.rungs[{i}]: {entry!r} is not an object")
+    # Multi-chip sweep: each mesh rung carries the tok/s + TTFT vs
+    # chips headline fields and the topology-matched budget evidence —
+    # validated element-wise (incl. each rung's nested ``spec`` block)
+    # so a rename in one rung's dict can't hide behind the list type.
+    multichip = result.get("multichip")
+    if isinstance(multichip, dict):
+        rungs = multichip.get("rungs")
+        if isinstance(rungs, list):
+            for i, entry in enumerate(rungs):
+                if isinstance(entry, dict):
+                    _check_types(f"multichip.rungs[{i}]", entry,
+                                 schema["multichip_rung"], errors)
+                    if isinstance(entry.get("spec"), dict):
+                        _check_types(f"multichip.rungs[{i}].spec",
+                                     entry["spec"], schema["spec"],
+                                     errors)
+                else:
+                    errors.append(
+                        f"multichip.rungs[{i}]: {entry!r} is not an "
+                        f"object")
     # KV-pressure scenario: each tiering-on/off arm carries the warm-TTFT
     # / restore-hit headline fields — validated element-wise so a rename
     # in one arm's dict can't hide behind the list type.

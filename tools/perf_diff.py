@@ -44,6 +44,13 @@ metric                                  direction  source
                                                    attainment gains must
                                                    not hide behind a
                                                    quietly fatter fleet
+``multichip.tokens_per_sec@<mesh>``     higher     multichip sweep, per
+                                                   mesh rung (tp=1,
+                                                   tp=2, ...)
+``multichip.ttft_p50_ms@<mesh>``        lower      multichip sweep, per
+                                                   mesh rung — TTFT must
+                                                   DROP as chips grow,
+                                                   not merely hold
 ======================================  =========  =====================
 
 Accepts raw bench results or the driver's artifact wrapper (an object
@@ -85,6 +92,12 @@ _FLEET_DIRECTIONS = {"prefix_hit_rate": "higher",
 _AUTOSCALE_DIRECTIONS = {"slo_attainment": "higher",
                          "replica_minutes": "lower",
                          "ttft_p50_ms": "lower"}
+
+#: multichip rung field -> (published gate name, direction); keyed per
+#: mesh rung, e.g. ``multichip.tokens_per_sec@tp=2``.
+_MULTICHIP_FIELDS = {"decode_tokens_per_sec": ("tokens_per_sec",
+                                               "higher"),
+                     "engine_p50_ttft_ms": ("ttft_p50_ms", "lower")}
 
 DEFAULT_THRESHOLD_PCT = 5.0
 
@@ -156,6 +169,18 @@ def extract_metrics(result: dict) -> dict[str, tuple[float, str]]:
                 v = _num(entry.get(key))
                 if v is not None:
                     out[f"autoscale.{key}@{policy}"] = (v, direction)
+    multichip = result.get("multichip")
+    if isinstance(multichip, dict):
+        for entry in multichip.get("rungs") or []:
+            if not isinstance(entry, dict):
+                continue
+            mesh = entry.get("mesh")
+            if not mesh:
+                continue
+            for field, (name, direction) in _MULTICHIP_FIELDS.items():
+                v = _num(entry.get(field))
+                if v is not None:
+                    out[f"multichip.{name}@{mesh}"] = (v, direction)
     return out
 
 

@@ -36,6 +36,12 @@ Checks:
   decision record + the endpoint payload validate element-wise, with
   the overloaded state required to produce a scale-up decision (an
   all-hold ring would validate while proving nothing).
+- **multichip** — the ``BENCH_MESH`` sweep's ``multichip`` section
+  contract: schema element-wise plus the semantic invariants (mesh
+  labels parse and match ``devices``, every rung carries a positive
+  topology-derived round budget, mesh rungs serve the ``fused_tp``
+  tail — a ``materialized`` mesh rung is the silent regression this
+  PR's tentpole removed).
 - **perf-gates** — ``tools/perf_diff.py`` over committed artifact
   pairs: each later round must not regress the earlier one's headline
   metrics (the same pairs/thresholds the tier-1 perf_diff test pins).
@@ -140,12 +146,99 @@ def check_bench_schema() -> list[str]:
         prompt_len=16, out_len=4, slots=2, steps_per_round=4,
         kv_pool_pages=8, device="cpu", rtt_ms=None, n_devices=1,
         bench_seconds=1.0, fleet=fleet, kv_pressure=kv_pressure,
-        autoscale=autoscale)
+        autoscale=autoscale, multichip=synthetic_multichip())
     try:
         validate_result(result)
     except BenchSchemaError as exc:
         return [str(exc)]
     return []
+
+
+def synthetic_multichip() -> dict:
+    """A fully-populated ``multichip`` bench section (the BENCH_MESH
+    sweep's output shape) — shared by the bench-schema synthetic result
+    and the multichip check below; returned fresh so the tier-1 test
+    can doctor a copy to prove the check fails."""
+    return {
+        "mesh_sweep": ["tp=1", "tp=2"],
+        "prompt_len": 16, "output_len": 4, "requests_per_rung": 2,
+        "slots": 2,
+        "rungs": [
+            {"mesh": "tp=1", "devices": 1,
+             "engine_p50_ttft_ms": 20.0, "engine_p99_ttft_ms": 25.0,
+             "decode_tokens_per_sec": 100.0,
+             "tokens_per_sec_per_device": 100.0,
+             "sched_round_budget_tokens": 256,
+             "cost_source": "PROFILE_preflight.json",
+             "cost_topology": "tp=1", "tail": "fused",
+             "engine_downgrades": 0, "spec": None},
+            {"mesh": "tp=2", "devices": 2,
+             "engine_p50_ttft_ms": 14.0, "engine_p99_ttft_ms": 18.0,
+             "decode_tokens_per_sec": 160.0,
+             "tokens_per_sec_per_device": 80.0,
+             "sched_round_budget_tokens": 384,
+             "cost_source": "PROFILE_preflight.json@tp=2",
+             "cost_topology": "tp=2", "tail": "fused_tp",
+             "engine_downgrades": 0,
+             "spec": {"draft_tokens": 8, "accepted_tokens": 5,
+                      "verify_rounds": 3, "acceptance_rate": 0.625,
+                      "tokens_per_step": 1.6}},
+        ],
+    }
+
+
+def validate_multichip_block(block: dict) -> list[str]:
+    """Element-wise + semantic validation of one ``multichip`` section:
+    schema per rung, parseable mesh labels whose axis product matches
+    ``devices``, a positive topology-derived round budget, and a tail
+    mode from the known set (a mesh rung reading ``materialized`` means
+    the sharded fused tail silently regressed to the fallback)."""
+    import re as _re
+
+    sys.path.insert(0, REPO)
+    from tools.check_bench_schema import (BenchSchemaError, load_schema,
+                                          validate_result)
+    errors: list[str] = []
+    try:
+        validate_result({"multichip": block},
+                        schema={**load_schema(),
+                                "top_level": {"multichip": ["obj"]}})
+    except BenchSchemaError as exc:
+        errors.append(str(exc))
+    for i, rung in enumerate(block.get("rungs") or []):
+        if not isinstance(rung, dict):
+            continue
+        mesh = str(rung.get("mesh", ""))
+        if not _re.fullmatch(r"[a-z]+=\d+(,[a-z]+=\d+)*", mesh):
+            errors.append(f"rungs[{i}]: mesh label {mesh!r} is not "
+                          f"axis=N[,axis=N...]")
+            continue
+        product = 1
+        for part in mesh.split(","):
+            product *= int(part.split("=")[1])
+        if product != rung.get("devices"):
+            errors.append(
+                f"rungs[{i}]: devices={rung.get('devices')} does not "
+                f"match mesh {mesh!r} (axis product {product})")
+        if not rung.get("sched_round_budget_tokens", 0) > 0:
+            errors.append(f"rungs[{i}]: sched_round_budget_tokens must "
+                          f"be > 0 (no topology row produced a budget)")
+        if rung.get("tail") not in ("fused_tp", "fused", "materialized"):
+            errors.append(f"rungs[{i}]: unknown tail mode "
+                          f"{rung.get('tail')!r}")
+        if rung.get("devices", 1) > 1 and rung.get("tail") != "fused_tp":
+            errors.append(
+                f"rungs[{i}]: mesh rung {mesh!r} served with tail="
+                f"{rung.get('tail')!r} — the tp-sharded fused sampler "
+                f"regressed to a fallback")
+    return errors
+
+
+def check_multichip() -> list[str]:
+    """Validate the multichip sweep contract over the synthetic section
+    (schema + mesh-label/device/budget/tail invariants) — the same
+    validator bench consumers can run over a real BENCH_MESH artifact."""
+    return validate_multichip_block(synthetic_multichip())
 
 
 def check_metrics_docs() -> list[str]:
@@ -353,6 +446,7 @@ CHECKS: dict[str, Callable[[], list[str]]] = {
     "metrics-lint": check_metrics_lint,
     "fleet-obs": check_fleet_obs,
     "autoscale": check_autoscale,
+    "multichip": check_multichip,
     "perf-gates": check_perf_gates,
 }
 

@@ -38,7 +38,7 @@ from generativeaiexamples_tpu.utils.hbm import peak_bw as _peak_bw
 def profile_rung(params, cfg, *, slots: int, window: int, live_pages: int,
                  steps: int, page: int, dtype, kv_quant: bool,
                  param_bytes: int, use_kernel: bool,
-                 verify_tokens: int = 8) -> dict:
+                 verify_tokens: int = 8, mesh=None) -> dict:
     """Measure one slot-count rung: the full decode round and its
     ablations (no-unembed, window=1), per step, plus the speculative
     VERIFY step (one ``verify_tokens``-position multi-token forward at
@@ -52,6 +52,16 @@ def profile_rung(params, cfg, *, slots: int, window: int, live_pages: int,
     n_pages = B * W + 1
     cache = llama.init_paged_kv_cache(cfg, n_pages, page, dtype,
                                       quantized=kv_quant)
+    if mesh is not None:
+        # Honest tp rungs: the pool lives sharded exactly as the
+        # engine's device state does (KV heads over tp when they
+        # divide), so the measured step includes the same collectives.
+        from jax.sharding import NamedSharding
+        from generativeaiexamples_tpu.parallel.sharding import (
+            paged_kv_cache_spec)
+        cache = jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+            cache, paged_kv_cache_spec(cfg, mesh, quantized=kv_quant))
     table = jnp.asarray(
         np.arange(1, 1 + B * W, dtype=np.int32).reshape(B, W))
     pos0 = jnp.full((B,), live_pages * page - K - 2, jnp.int32)
@@ -69,7 +79,8 @@ def profile_rung(params, cfg, *, slots: int, window: int, live_pages: int,
                     tbl, p_eff = table, pos
                 logits, cache = llama.apply_decode_paged(
                     params, cfg, tok[:, None], p_eff[:, None], cache, tbl,
-                    p_eff + 1, wp, p_eff % page, use_kernel=use_kernel)
+                    p_eff + 1, wp, p_eff % page, use_kernel=use_kernel,
+                    mesh=mesh)
                 if ablate == "no_unembed":
                     tok = (logits[:, 0, :8].sum(-1) * 0).astype(
                         jnp.int32) + tok
@@ -173,7 +184,18 @@ def profile_rung(params, cfg, *, slots: int, window: int, live_pages: int,
     }
 
 
-def main(json_path: str = "", slots_arg: str = ""):
+def parse_mesh_arg(spec: str) -> dict:
+    """``tp=2`` / ``tp=2,sp=2`` -> {"tp": 2, "sp": 2}; the shared
+    ``parallel.mesh.parse_mesh_spec`` grammar, surfaced as the CLI exit
+    (a typo'd axis would silently profile single-chip)."""
+    from generativeaiexamples_tpu.parallel.mesh import parse_mesh_spec
+    try:
+        return parse_mesh_spec(spec)
+    except ValueError as exc:
+        raise SystemExit(f"--mesh {exc}")
+
+
+def main(json_path: str = "", slots_arg: str = "", mesh_arg: str = ""):
     from generativeaiexamples_tpu.models import llama
     from generativeaiexamples_tpu.models.configs import get_model_config
     from generativeaiexamples_tpu.ops.quant import quantize_params
@@ -196,19 +218,43 @@ def main(json_path: str = "", slots_arg: str = ""):
         return quantize_params(p, quant) if quant != "none" else p
     params = jax.jit(make)(jax.random.key(0))
     jax.block_until_ready(params)
+
+    # --mesh tp=N (or PROF_MESH): measure the SHARDED decode round —
+    # params placed per llama_param_specs, the pool per
+    # paged_kv_cache_spec, kernel shard_mapped when the heads divide —
+    # so the artifact carries per-TOPOLOGY costs. The topology label
+    # (engine/scheduler.py topology_key) keys the row; the engine's
+    # StepCostModel.load(topology=...) picks the matching one, which is
+    # what makes a tp engine's first-round budget honest.
+    mesh = None
+    mesh_arg = mesh_arg or os.environ.get("PROF_MESH", "")
+    topo = "tp=1"
+    if mesh_arg:
+        from generativeaiexamples_tpu.engine.scheduler import topology_key
+        from generativeaiexamples_tpu.parallel import (
+            MeshPlan, llama_param_specs, make_mesh, shard_params)
+        axes = parse_mesh_arg(mesh_arg)
+        n_dev = 1
+        for v in axes.values():
+            n_dev *= v
+        mesh = make_mesh(MeshPlan(**axes), jax.devices()[:n_dev])
+        params = shard_params(params, mesh, llama_param_specs(cfg, mesh))
+        topo = topology_key(dict(mesh.shape))
+        print(f"mesh: {dict(mesh.shape)} -> topology {topo!r}")
     param_bytes = sum(x.nbytes for x in jax.tree.leaves(params))
     print(f"params: {param_bytes/1e9:.2f} GB  "
           f"slots={sweep or B} window={W} live={live_pages} steps={K}")
 
     kv_quant = os.environ.get("PROF_KV_QUANT", "") == "int8"
-    use_kernel = jax.default_backend() == "tpu"
+    use_kernel = jax.default_backend() == "tpu" \
+        and llama.kernel_tp_compatible(cfg, mesh)
     floor = param_bytes / _peak_bw(jax.local_devices()[0]) * 1e3
     verify_tokens = int(os.environ.get("PROF_VERIFY_TOKENS", "8"))
 
     rungs = [profile_rung(
         params, cfg, slots=s, window=W, live_pages=live_pages, steps=K,
         page=page, dtype=dt, kv_quant=kv_quant, param_bytes=param_bytes,
-        use_kernel=use_kernel, verify_tokens=verify_tokens)
+        use_kernel=use_kernel, verify_tokens=verify_tokens, mesh=mesh)
         for s in (sweep or [B])]
     r0 = rungs[0]
     print(f"=> unembed+argmax ~{r0['unembed_ms_per_step']:.2f} ms/step, "
@@ -268,6 +314,13 @@ def main(json_path: str = "", slots_arg: str = ""):
             "prefill_bucket_tokens": S,
             "prefill_ms_per_token": round(prefill_ms_tok, 4),
             "verify_positions": verify_tokens,
+            # Topology row key (engine/scheduler.py topology_key):
+            # which mesh shape these costs were measured at. "tp=1" =
+            # single chip; StepCostModel.load(topology=...) matches an
+            # engine's mesh against this label (or a "topologies" dict
+            # of per-mesh rows merged over the shared fields).
+            "topology": topo,
+            "mesh_devices": mesh.devices.size if mesh is not None else 1,
         }
         if sweep:
             # Sweep shape: one attribution entry per slot rung. The
@@ -303,5 +356,11 @@ if __name__ == "__main__":
                          "(e.g. 8,16,32,64) measured with shared params; "
                          "the artifact carries per-rung attribution + "
                          "achieved-bandwidth fraction")
+    ap.add_argument("--mesh", default="", metavar="tp=N",
+                    help="measure the TP-SHARDED decode round on a mesh "
+                         "(axis=N pairs, e.g. tp=2 or tp=2,sp=2): params "
+                         "+ paged pool placed per the serving shardings, "
+                         "artifact stamped with the topology_key row the "
+                         "engine's cost model matches against")
     args = ap.parse_args()
-    main(json_path=args.json, slots_arg=args.slots)
+    main(json_path=args.json, slots_arg=args.slots, mesh_arg=args.mesh)
