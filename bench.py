@@ -54,7 +54,7 @@ import os
 import sys
 import threading
 import time
-from typing import Optional
+from typing import Optional, Sequence
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
@@ -774,37 +774,50 @@ def run_multichip_sweep(params, model_cfg, tokenizer, rungs, *,
 
 
 def build_fleet_engines(params, model_cfg, tokenizer, n: int,
-                        host_pool_tokens: int = 0):
+                        host_pool_tokens: int = 0,
+                        roles: Sequence[str] = (),
+                        max_input_length: int = 2048):
     """N small replica engines over SHARED params (read-only on device —
     weights are never duplicated) with explicit, modest KV pools
     (``BENCH_FLEET_KV_POOL_TOKENS``, default 4096 tokens each): the main
     bench engine's auto-sized pool still holds its HBM, so auto-sizing
     here would starve; prewarm's shrink-on-OOM absorbs the rest.
     ``host_pool_tokens`` > 0 enables the host KV tier on every replica
-    (the cross-replica transfer arm needs it to land fetched pages)."""
+    (the cross-replica transfer arm needs it to land fetched pages).
+    ``roles`` assigns each replica a disaggregation role
+    (docs/disaggregation.md) — empty means all-unified."""
+    import dataclasses
+
     from generativeaiexamples_tpu.engine import Engine, EngineConfig
 
     pool = int(os.environ.get("BENCH_FLEET_KV_POOL_TOKENS", "4096"))
     slots = int(os.environ.get("BENCH_FLEET_SLOTS", "4"))
     ecfg = EngineConfig(
-        max_slots=slots, max_input_length=2048, max_output_length=128,
+        max_slots=slots, max_input_length=max_input_length,
+        max_output_length=128,
         prefill_buckets=(512, 1024), dtype="bfloat16",
         kv_pool_tokens=pool,
         kv_quant=os.environ.get("BENCH_KV_QUANT", ""),
         steps_per_round=int(os.environ.get("BENCH_STEPS_PER_ROUND", "16")),
         dispatch_depth=int(os.environ.get("BENCH_DISPATCH_DEPTH", "2")),
         kv_host_pool_tokens=max(0, int(host_pool_tokens)))
-    # Mask the env override for the build: KV_HOST_POOL_TOKENS beats the
-    # config field inside Engine, and the fleet arms' tier setting must
-    # come from `host_pool_tokens` (the arm matrix), not from whatever
-    # the operator pinned for the MAIN measured engine.
+    # Mask the env overrides for the build: KV_HOST_POOL_TOKENS /
+    # ENGINE_ROLE beat the config fields inside Engine, and the fleet
+    # arms' tier + role settings must come from the arm matrix, not from
+    # whatever the operator pinned for the MAIN measured engine.
     saved = os.environ.pop("KV_HOST_POOL_TOKENS", None)
+    saved_role = os.environ.pop("ENGINE_ROLE", None)
     try:
-        engines = [Engine(params, model_cfg, tokenizer, ecfg)
-                   for _ in range(n)]
+        engines = [Engine(params, model_cfg, tokenizer,
+                          dataclasses.replace(
+                              ecfg, role=(roles[i] if i < len(roles)
+                                          else "unified")))
+                   for i in range(n)]
     finally:
         if saved is not None:
             os.environ["KV_HOST_POOL_TOKENS"] = saved
+        if saved_role is not None:
+            os.environ["ENGINE_ROLE"] = saved_role
     for e in engines:
         e.prewarm()
     return engines
@@ -1075,6 +1088,217 @@ def run_fleet_bench(engines, *, sessions=6, turns=4, session_rps=2.0,
         "num_tokens": int(num_tokens),
         "policies": policy_rows,
         "fleet_obs": fleet_obs,
+    }
+
+
+def run_disagg_bench(params, model_cfg, tokenizer, *,
+                     replicas=2, requests=24, rps=4.0,
+                     long_frac=0.4, long_chars=4600, short_chars=400,
+                     num_tokens=16, seed=0, heartbeat_s=0.5,
+                     max_input_length=4096):
+    """Disaggregated prefill/decode vs unified at EQUAL chips
+    (docs/disaggregation.md): two arms over an adversarial long/short
+    prompt mix.
+
+    - ``unified``: ``replicas`` unified replicas — long prompts chunk-
+      prefill on whichever replica serves them, stealing round budget
+      from every short request decoding there (head-of-line TTFT).
+    - ``disagg``: the SAME chip count split 1 prefill +
+      ``replicas - 1`` decode — long prompts run their prefill on the
+      prefill replica and arrive at the decode replica as a pushed
+      near-full prefix hit, so decode rounds never absorb long-prefill
+      work.
+
+    Long prompts are sized past the router's
+    ``ROUTER_DISAGG_MIN_PROMPT_BYTES`` gate; short ones under it. Per
+    arm: TTFT p50/p99 (and long/short split), decode goodput
+    (fleet-summed ``tokens_generated`` over the traffic wall-clock),
+    and the handoff accounting (router handoffs/fallbacks, engine
+    export/shed counters). The headline claim — disagg beats unified on
+    BOTH ttft_p50_ms and decode_goodput — is gated round-over-round by
+    ``tools/perf_diff.py`` (``disagg.*@<arm>``)."""
+    import statistics
+
+    import numpy as _np
+    import requests as _rq
+
+    from generativeaiexamples_tpu.chains.examples.developer_rag import (
+        QAChatbot)
+    from generativeaiexamples_tpu.chains.llm import EngineLLM
+    from generativeaiexamples_tpu.chains.server import create_app
+    from generativeaiexamples_tpu.embed.encoder import HashEmbedder
+    from generativeaiexamples_tpu.obs import metrics as obs_metrics
+    from generativeaiexamples_tpu.router.server import create_router_app
+    from generativeaiexamples_tpu.utils.app_config import AppConfig
+    from generativeaiexamples_tpu.utils.configuration import from_dict
+
+    cfg = from_dict(AppConfig, {
+        "llm": {"model_engine": "tpu-jax"},
+        "embeddings": {"model_engine": "hash", "dimensions": 32},
+    })
+    pool = int(os.environ.get("BENCH_FLEET_KV_POOL_TOKENS", "4096"))
+
+    def words(tag: str, n_chars: int) -> str:
+        import hashlib
+        h = int.from_bytes(hashlib.blake2b(
+            tag.encode(), digest_size=4).digest(), "little")
+        rng = _np.random.RandomState(h)
+        toks = []
+        total = 0
+        while total < n_chars:
+            w = "".join(chr(97 + c) for c in rng.randint(0, 26, size=5))
+            toks.append(w)
+            total += 6
+        return " ".join(toks)[:n_chars]
+
+    # The adversarial mix, shaped once and shared by both arms (content
+    # is arm-tagged below so no arm rides the other's warm pages).
+    rng = _np.random.RandomState(seed)
+    kinds = ["long" if rng.random_sample() < long_frac else "short"
+             for _ in range(requests)]
+    delays = _np.cumsum(rng.exponential(1.0 / rps, size=requests))
+
+    def one_arm(label: str, roles: list[str]) -> dict:
+        engines = build_fleet_engines(
+            params, model_cfg, tokenizer, replicas,
+            host_pool_tokens=pool * 4, roles=roles,
+            max_input_length=max_input_length)
+        for eng in engines:
+            eng.start()
+        try:
+            apps = [create_app(QAChatbot(llm=EngineLLM(eng),
+                                         embedder=HashEmbedder(dim=32),
+                                         config=cfg, fused_rag=False),
+                               config=cfg)
+                    for eng in engines]
+            replica_urls, stop_replicas = serve_apps(apps)
+            router_app = create_router_app(
+                [(f"r{i}", u) for i, u in enumerate(replica_urls)],
+                policy="affinity", heartbeat_s=heartbeat_s,
+                kv_transfer=True, run_heartbeat=True)
+            (router_url,), stop_router = serve_apps([router_app])
+            # Sync the role/capacity view before traffic: placement must
+            # already know who is prefill when the first long prompt
+            # lands.
+            _rq.post(f"{router_url}/control/heartbeat", timeout=30)
+            snap0 = obs_metrics.REGISTRY.snapshot()
+            before = [dict(e.stats) for e in engines]
+            results: list[dict] = []
+            res_lock = threading.Lock()
+
+            def run_request(i: int, start_delay: float):
+                time.sleep(max(0.0, start_delay))
+                kind = kinds[i]
+                tag = f"disagg-{label}-{seed}-{i}"
+                n_chars = long_chars if kind == "long" else short_chars
+                t0 = time.monotonic()
+                row = {"i": i, "kind": kind, "ok": False, "ttft_ms": None}
+                try:
+                    with _rq.post(
+                            f"{router_url}/generate",
+                            json={"question": words(f"{tag}-q", 80),
+                                  "context": words(tag, n_chars),
+                                  "use_knowledge_base": False,
+                                  "num_tokens": num_tokens},
+                            stream=True, timeout=300) as resp:
+                        if resp.status_code == 200:
+                            it = resp.iter_content(chunk_size=1)
+                            body = b""
+                            for b in it:
+                                body = b
+                                row["ttft_ms"] = \
+                                    (time.monotonic() - t0) * 1e3
+                                break
+                            for b in it:
+                                body += b
+                            answer = body.decode("utf-8",
+                                                 errors="replace")
+                            row["ok"] = "[error]" not in answer
+                        else:
+                            row["status"] = resp.status_code
+                except _rq.RequestException as exc:
+                    row["error"] = str(exc)
+                with res_lock:
+                    results.append(row)
+
+            t_traffic = time.monotonic()
+            threads = [threading.Thread(target=run_request,
+                                        args=(i, delays[i]), daemon=True)
+                       for i in range(requests)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(timeout=600)
+            elapsed = max(1e-3, time.monotonic() - t_traffic)
+            stop_router()
+            stop_replicas()
+            snap1 = obs_metrics.REGISTRY.snapshot()
+            after = [dict(e.stats) for e in engines]
+        finally:
+            for eng in engines:
+                try:
+                    eng.stop()
+                except Exception:  # noqa: BLE001
+                    pass
+
+        def _delta(key: str) -> float:
+            return snap1.get(key, 0.0) - snap0.get(key, 0.0)
+
+        def _stat(key: str) -> int:
+            return int(sum(a.get(key, 0) - b.get(key, 0)
+                           for a, b in zip(after, before)))
+
+        ok_rows = [r for r in results if r["ok"]]
+        ttfts = sorted(r["ttft_ms"] for r in ok_rows
+                       if r["ttft_ms"] is not None)
+
+        def _p50(kind: Optional[str] = None):
+            xs = sorted(r["ttft_ms"] for r in ok_rows
+                        if r["ttft_ms"] is not None
+                        and (kind is None or r["kind"] == kind))
+            return round(statistics.median(xs), 2) if xs else None
+
+        role_counts: dict[str, int] = {}
+        for role in (roles or ["unified"] * replicas):
+            role_counts[role] = role_counts.get(role, 0) + 1
+        fallbacks = int(sum(
+            _delta(f'router_disagg_fallbacks_total{{reason="{r}"}}')
+            for r in ("prefill_error", "prefill_timeout", "no_pages")))
+        return {
+            "arm": label,
+            "roles": role_counts,
+            "offered": int(requests),
+            "completed": len(ok_rows),
+            "errors": len(results) - len(ok_rows),
+            "ttft_p50_ms": _p50(),
+            "ttft_p99_ms": (round(
+                ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.99))], 2)
+                if ttfts else None),
+            "long_ttft_p50_ms": _p50("long"),
+            "short_ttft_p50_ms": _p50("short"),
+            "tokens_generated": _stat("tokens_generated"),
+            "decode_goodput": round(
+                _stat("tokens_generated") / elapsed, 1),
+            "handoffs": int(_delta("router_disagg_handoffs_total")),
+            "fallbacks": fallbacks,
+            "kv_export_pages": _stat("kv_tier_export_pages"),
+            "kv_export_shed": _stat("kv_export_shed"),
+            "kv_transfer_pages": _stat("kv_tier_transfer_pages"),
+        }
+
+    arms = [
+        one_arm("unified", ["unified"] * replicas),
+        one_arm("disagg", ["prefill"] + ["decode"] * (replicas - 1)),
+    ]
+    return {
+        "replicas": int(replicas),
+        "requests": int(requests),
+        "rps": float(rps),
+        "long_frac": float(long_frac),
+        "long_chars": int(long_chars),
+        "short_chars": int(short_chars),
+        "num_tokens": int(num_tokens),
+        "arms": arms,
     }
 
 
@@ -1539,7 +1763,7 @@ def assemble_result(*, kind, model, headline, engine_p50, engine_p99, tput,
                     bench_seconds, e2e_tps_p50=None, openloop=None,
                     fleet=None, capacity=None, rounds=None,
                     kv_pressure=None, autoscale=None,
-                    multichip=None) -> dict:
+                    multichip=None, disagg=None) -> dict:
     """The bench's single output contract. Every field name here is
     pinned by tools/bench_schema.json (validated at emit time AND by the
     tier-1 suite, tests/test_bench_schema.py) so a rename fails fast
@@ -1605,6 +1829,11 @@ def assemble_result(*, kind, model, headline, engine_p50, engine_p99, tput,
         # equal-average static fleet — slo_attainment + replica_minutes
         # per arm (docs/autoscaling.md). Null when not requested.
         "autoscale": autoscale,
+        # Disaggregation scenario (BENCH_DISAGG=1): prefill/decode chip
+        # pools vs a unified fleet at equal chips over an adversarial
+        # long/short prompt mix — TTFT p50 + decode goodput per arm
+        # (docs/disaggregation.md). Null when not requested.
+        "disagg": disagg,
         "quantization": quant,
         "kv_quant": kv_quant,
         "weights": weights,
@@ -2120,6 +2349,33 @@ def main() -> None:
                 except Exception:  # noqa: BLE001
                     pass
 
+    # Disaggregation scenario (BENCH_DISAGG=1): 1 prefill + N-1 decode
+    # replicas vs N unified at equal chips, adversarial long/short mix
+    # (docs/disaggregation.md). Per-arm engines are built and stopped
+    # inside the scenario (the role matrix differs per arm). Degrades
+    # to null.
+    disagg = None
+    if os.environ.get("BENCH_DISAGG", "") not in ("", "0"):
+        try:
+            disagg = run_disagg_bench(
+                engine.params, model_cfg, engine.tokenizer,
+                replicas=int(os.environ.get(
+                    "BENCH_DISAGG_REPLICAS", "2")),
+                requests=int(os.environ.get(
+                    "BENCH_DISAGG_REQUESTS", "24")),
+                rps=float(os.environ.get("BENCH_DISAGG_RPS", "4")),
+                long_frac=float(os.environ.get(
+                    "BENCH_DISAGG_LONG_FRAC", "0.4")),
+                long_chars=int(os.environ.get(
+                    "BENCH_DISAGG_LONG_CHARS", "4600")),
+                short_chars=int(os.environ.get(
+                    "BENCH_DISAGG_SHORT_CHARS", "400")),
+                num_tokens=int(os.environ.get(
+                    "BENCH_DISAGG_TOKENS", "16")),
+                seed=int(os.environ.get("BENCH_SEED", "0")))
+        except Exception as exc:  # noqa: BLE001
+            sys.stderr.write(f"bench: disagg scenario failed: {exc}\n")
+
     import jax
     # Headline = the full QA-chatbot path (BASELINE.json's north star is
     # the *chatbot* TTFT, not the engine-only number — VERDICT r3 weak
@@ -2134,7 +2390,7 @@ def main() -> None:
         e2e_breakdown=e2e_breakdown, e2e_tps_p50=e2e_tps_p50,
         pipeline=pipeline, openloop=openloop, fleet=fleet,
         capacity=capacity, rounds=rounds, kv_pressure=kv_pressure,
-        autoscale=autoscale, multichip=multichip,
+        autoscale=autoscale, multichip=multichip, disagg=disagg,
         quant=quant, kv_quant=engine.cfg.kv_quant or None,
         weights=("real" if os.environ.get("BENCH_MODEL_PATH")
                  else "random-init"),

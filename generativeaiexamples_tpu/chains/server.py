@@ -70,7 +70,7 @@ from ..obs.tracing import instrumented
 from ..serving.streaming import iterate_in_thread
 from ..utils import resilience
 from ..utils.errors import (BreakerOpenError, ChainError, EngineError,
-                            SchedulerFullError)
+                            RoleMismatchError, SchedulerFullError)
 from ..utils.logging import get_logger
 from .base import BaseExample
 
@@ -280,6 +280,19 @@ def create_app(example: BaseExample,
                     "model_source": str(cost.source),
                     "capacity_tokens_per_sec": round(
                         engine.cfg.max_slots * 1e3 / step_ms, 1),
+                    # Handoff pricing inputs (docs/disaggregation.md):
+                    # the router's disaggregation gate prices the
+                    # two-leg page transfer against recompute with THIS
+                    # replica's calibrated per-token/per-page costs
+                    # (table.handoff_beats_prefill) — the same numbers
+                    # the engine's own restore_cheaper admission uses.
+                    "prefill_ms_per_token": round(
+                        float(cost.prefill_ms_per_token), 6),
+                    "h2d_ms_per_page": round(
+                        float(cost.h2d_ms_per_page), 4),
+                    "d2h_ms_per_page": round(
+                        float(cost.d2h_ms_per_page), 4),
+                    "page_size": int(engine.cfg.page_size),
                 }
         except Exception:  # noqa: BLE001
             logger.debug("capacity block unavailable", exc_info=True)
@@ -310,10 +323,15 @@ def create_app(example: BaseExample,
             status, code = "breaker_open", 503
         else:
             status, code = "ok", 200
+        engine = getattr(getattr(example, "llm", None), "engine", None)
         return web.json_response(
             {"status": status, "draining": drain.draining,
-             "breaker": breaker.state, "load": _load_block(),
-             **_obs_blocks()},
+             "breaker": breaker.state,
+             # Disaggregation role, heartbeat-advertised: the router's
+             # role-aware placement and the per-role autoscale targets
+             # both read it from here (docs/disaggregation.md).
+             "role": getattr(engine, "role", "unified") or "unified",
+             "load": _load_block(), **_obs_blocks()},
             status=code)
 
     async def control_drain(request: web.Request) -> web.Response:
@@ -565,6 +583,15 @@ def create_app(example: BaseExample,
             _shed("breaker_open")
             return error_response(503, "dependency_unavailable", str(exc),
                                   rid, retry_after_s=exc.retry_after_s)
+        except RoleMismatchError as exc:
+            # Misrouted, not broken: a prefill-role engine refusing a
+            # decode-bound request is a placement error the router must
+            # retry elsewhere — release the probe (the engine is fine)
+            # and answer a retryable 429, never a breaker-feeding 503.
+            release()
+            _shed("role_mismatch")
+            return error_response(429, "role_mismatch", str(exc), rid,
+                                  retry_after_s=1.0)
         except EngineError as exc:
             report(False)  # engine down/failing: feeds the fast-503 breaker
             return error_response(503, "engine_error", str(exc), rid)
@@ -650,6 +677,17 @@ def create_app(example: BaseExample,
                           "(KV_HOST_POOL_TOKENS=0)")
         return engine, None
 
+    # Donor-side export bound (docs/disaggregation.md): at most
+    # KV_EXPORT_CONCURRENCY simultaneous /control/kv_pages exports —
+    # each one is a device page-gather control op stealing time from
+    # decode rounds, so N handoff pulls arriving together must shed
+    # past the cap (429 + Retry-After, counted as kv_export_shed)
+    # instead of stalling every live stream on this replica. A plain
+    # counter, not an asyncio.Semaphore: rejection is the point.
+    kv_export_limit = max(1, int(os.environ.get(
+        "KV_EXPORT_CONCURRENCY", "2") or 2))
+    kv_export_active = [0]
+
     async def kv_pages(request: web.Request) -> web.Response:
         """``GET /control/kv_pages?hashes=<hex,...>`` — the cross-
         replica prefix-page transfer donor side (docs/kv-tiering.md):
@@ -670,6 +708,17 @@ def create_app(example: BaseExample,
         if not hashes:
             raise web.HTTPUnprocessableEntity(
                 text="at least one block hash is required")
+        if kv_export_active[0] >= kv_export_limit:
+            try:
+                engine._bump("kv_export_shed")
+            except Exception:  # noqa: BLE001 — shedding must not 500
+                logger.debug("kv_export_shed bump failed", exc_info=True)
+            return error_response(
+                429, "kv_export_busy",
+                f"{kv_export_active[0]} KV export(s) already in flight "
+                f"(cap {kv_export_limit}); retry or place cold", rid,
+                retry_after_s=1.0)
+        kv_export_active[0] += 1
         try:
             blob, n = await asyncio.wait_for(
                 asyncio.get_running_loop().run_in_executor(
@@ -680,6 +729,8 @@ def create_app(example: BaseExample,
                 504, "timeout", "kv page export timed out", rid)
         except EngineError as exc:
             return error_response(503, "engine_error", str(exc), rid)
+        finally:
+            kv_export_active[0] -= 1
         return web.Response(
             body=blob, content_type="application/octet-stream",
             headers={"X-KV-Blocks": str(n), "X-Request-ID": rid})
@@ -744,6 +795,81 @@ def create_app(example: BaseExample,
             return error_response(422, "bad_blob", str(exc), rid)
         return web.json_response({"blocks": n, "request_id": rid})
 
+    async def control_prefill(request: web.Request) -> web.Response:
+        """``POST /control/prefill`` — leg 1 of the disaggregated
+        prefill/decode handoff (docs/disaggregation.md). Takes a
+        ``/generate``-shaped body, assembles the SAME prompt the decode
+        replica's chain will assemble (the config chat template), runs
+        it through this engine as a 1-token greedy generation (full
+        mesh on the prefill wall — the role cap admits it), then
+        exports the finished prefix chain and pushes it to the decode
+        replica named by ``X-KV-Push-To`` (``POST /control/kv_resume``
+        on the receiver). The decode replica then admits the real
+        request as a near-full prefix-cache hit. Every failure mode
+        degrades to recompute on the decode side — the router treats
+        any non-200 here as 'skip the handoff', never as a request
+        error."""
+        rid = obs_flight.adopt_request_id(request.headers)
+        engine, err = _tier_engine()
+        if err is not None:
+            return error_response(err[0], err[1], err[2], rid)
+        if drain.draining:
+            return _drain_reject(rid)
+        body = await request.json()
+        question = body.get("question", "")
+        context = body.get("context", "")
+        if not question:
+            raise web.HTTPUnprocessableEntity(text="'question' is required")
+        push_to = request.headers.get("X-KV-Push-To") or None
+        from ..engine import kv_tier
+        if push_to is not None and not kv_tier.donor_allowed(push_to):
+            return error_response(
+                403, "push_target_not_allowed",
+                f"push target {push_to} is outside KV_TRANSFER_ALLOW",
+                rid)
+        # Byte-identical prompt assembly with the decode replica's
+        # llm_chain (chat_template.format) — the exported block chain
+        # hashes the same token ids or it warms nothing.
+        try:
+            prompt = example.config.prompts.chat_template.format(
+                context_str=context or "", query_str=question)
+        except Exception:  # noqa: BLE001 — template-less example
+            prompt = f"{context}\n{question}" if context else question
+
+        def run_prefill() -> tuple[int, bool]:
+            from ..engine.sampling_params import SamplingParams
+            stream = engine.stream_text(
+                prompt, SamplingParams(max_tokens=1, top_k=1),
+                request_id=rid)
+            for _ in stream:    # drain the single greedy token: the
+                pass            # prefix pages are finished after it
+            out = engine.export_handoff(engine.tokenizer.encode(prompt))
+            if out is None:
+                return 0, False
+            blob, n = out
+            pushed = False
+            if push_to is not None:
+                pushed = kv_tier.push_blob(
+                    push_to, blob,
+                    timeout_s=engine._kv_tier.transfer_timeout_s)
+            return n, pushed
+
+        try:
+            n, pushed = await asyncio.wait_for(
+                asyncio.get_running_loop().run_in_executor(
+                    None, run_prefill),
+                timeout=executor_timeout_s)
+        except asyncio.TimeoutError:
+            return error_response(
+                504, "timeout", "prefill handoff timed out", rid)
+        except SchedulerFullError as exc:
+            return error_response(429, "queue_full", str(exc), rid,
+                                  retry_after_s=1.0)
+        except EngineError as exc:
+            return error_response(503, "engine_error", str(exc), rid)
+        return web.json_response(
+            {"blocks": n, "pushed": pushed, "request_id": rid})
+
     async def metrics_endpoint(request: web.Request) -> web.Response:
         # Scrape-time engine snapshot: when the example serves an
         # in-process engine (EngineLLM), surface its counters — decode
@@ -782,6 +908,7 @@ def create_app(example: BaseExample,
     app.router.add_get("/control/kv_pages", kv_pages)
     app.router.add_post("/control/kv_suspend", kv_suspend)
     app.router.add_post("/control/kv_resume", kv_resume)
+    app.router.add_post("/control/prefill", control_prefill)
     return app
 
 

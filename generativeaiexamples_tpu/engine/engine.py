@@ -73,7 +73,8 @@ from ..ops.sampling import (apply_repetition_penalty, mask_words,
 from ..parallel.sharding import (llama_param_specs, paged_kv_cache_spec,
                                  shard_params)
 from ..utils import faults
-from ..utils.errors import ConfigError, EngineError, SchedulerFullError
+from ..utils.errors import (ConfigError, EngineError, RoleMismatchError,
+                            SchedulerFullError)
 from ..utils.hbm import peak_bw
 from ..utils.logging import get_logger, log_event
 from . import kv_tier as kv_tier_mod
@@ -178,6 +179,14 @@ _STATS_TEMPLATE = {
     "kv_tier_transfer_pages": 0,
     "kv_tier_suspended_blocks": 0,
     "kv_tier_resumed_blocks": 0,
+    # Disaggregated prefill/decode handoff (docs/disaggregation.md):
+    # finished prefix pages exported for push-on-completion handoff to a
+    # decode replica, and donor-side /control/kv_pages exports refused
+    # because the concurrent-export bound was already held (the chain
+    # server's semaphore sheds with 429 + Retry-After so N simultaneous
+    # handoffs can't stall this engine's decode rounds).
+    "kv_tier_export_pages": 0,
+    "kv_export_shed": 0,
     # Round telemetry (obs/rounds.py): engine rounds whose plan AND
     # every harvested device output have been recorded — the flight-
     # recorder-style per-round records behind GET /debug/rounds.
@@ -326,6 +335,17 @@ class EngineConfig:
     # 0 (the default) disables the tier entirely — the engine then
     # byte-for-byte preserves the untiered eviction behavior.
     kv_host_pool_tokens: Optional[int] = None
+    # Disaggregation role (docs/disaggregation.md): "unified" serves
+    # everything (the default — a role-less fleet byte-for-byte
+    # preserves today's behavior); "prefill" runs long prompts at full
+    # mesh utilization with decode-bound admission DISABLED (submit
+    # rejects requests wanting more than ROLE_PREFILL_MAX_TOKENS output
+    # tokens with RoleMismatchError) and exports finished prefix pages
+    # to decode siblings; "decode" advertises itself for short-prompt /
+    # decode-bound placement (the router keeps long prompts off it when
+    # a prefill sibling is placeable — advisory at the engine, enforced
+    # at placement). The ENGINE_ROLE env var beats this field.
+    role: str = "unified"
 
     def __post_init__(self) -> None:
         # Geometry validation lives on the config, not the engine — a bad
@@ -358,6 +378,10 @@ class EngineConfig:
                 f"spec_max_draft_tokens={self.spec_max_draft_tokens} "
                 f"must be >= 1 (it sizes the verify round's K+1 "
                 f"scoring positions)")
+        if self.role not in ("unified", "prefill", "decode"):
+            raise ConfigError(
+                f"role={self.role!r} not supported; use 'unified', "
+                f"'prefill', or 'decode' (docs/disaggregation.md)")
 
     @property
     def max_cache_len(self) -> int:
@@ -675,6 +699,21 @@ class Engine:
                     "KV_TRANSFER_MAX_PAGES", "32") or 32),
                 transfer_timeout_s=float(os.environ.get(
                     "KV_TRANSFER_TIMEOUT_S", "5") or 5))
+        # Disaggregation role: env beats config (the bench builds mixed
+        # fleets via per-engine configs; deployments roll roles via
+        # ENGINE_ROLE). "unified" changes nothing anywhere — the role
+        # paths below are all gated on it. A prefill-role engine rejects
+        # decode-bound requests at submit (more output tokens than the
+        # ROLE_PREFILL_MAX_TOKENS cap): its whole mesh belongs to the
+        # prefill wall; decode rounds stream from the decode pool.
+        env_role = (os.environ.get("ENGINE_ROLE", "") or "").strip().lower()
+        if env_role and env_role not in ("unified", "prefill", "decode"):
+            raise ConfigError(
+                f"ENGINE_ROLE={env_role!r} not supported; use 'unified', "
+                f"'prefill', or 'decode' (docs/disaggregation.md)")
+        self.role: str = env_role or cfg.role
+        self._role_prefill_max_tokens = max(1, int(os.environ.get(
+            "ROLE_PREFILL_MAX_TOKENS", "4") or 4))
         # Page gather/scatter programs for the tier (built lazily; jit
         # re-specializes per padded page-count rung automatically).
         # _io_rungs tracks scatter rungs already compiled: a rung's
@@ -2525,6 +2564,23 @@ class Engine:
         if self._fatal is not None:
             raise EngineError("engine is dead") from self._fatal
         params = params or SamplingParams()
+        prewarm_probe = bool(request_id) \
+            and request_id.startswith("engine-prewarm")
+        if self.role == "prefill" and not prewarm_probe \
+                and params.max_tokens > self._role_prefill_max_tokens:
+            # Role enforcement at admission: a prefill-role engine's
+            # mesh belongs to the prefill wall — a decode-bound request
+            # here would starve handoff exports behind its decode
+            # rounds. Routing error, not capacity: edges map this to a
+            # retryable 429 without tripping the breaker. Prewarm's own
+            # worst-case calibration probes are exempt — they run
+            # before the replica takes traffic and must exercise full
+            # decode rounds regardless of role.
+            raise RoleMismatchError(
+                f"prefill-role engine refuses decode-bound request "
+                f"(max_tokens={params.max_tokens} > role cap "
+                f"{self._role_prefill_max_tokens}); route it to a "
+                f"decode/unified replica")
         if len(prompt_ids) > self.cfg.max_input_length:
             raise EngineError(
                 f"prompt length {len(prompt_ids)} exceeds max_input_length "
@@ -3025,6 +3081,38 @@ class Engine:
         n = sum(1 for rec in records if self._kv_tier.store.put(rec))
         self._bump("kv_tier_resumed_blocks", n)
         return n
+
+    def export_handoff(self, token_ids: Sequence[int]
+                       ) -> Optional[tuple[bytes, int]]:
+        """Serialize a finished prompt's full prefix chain for
+        push-on-completion handoff to a decode replica
+        (docs/disaggregation.md). Unlike :meth:`suspend_session` the
+        pages STAY resident here (the donor keeps serving pull-side
+        ``/control/kv_pages`` fallbacks for the same prefix), and unlike
+        :meth:`export_blob` the chain is NOT capped at the transfer page
+        cap — it is collected in transfer-cap slices, one control op
+        each, so decode rounds interleave between slices and the export
+        overlaps them instead of stalling them. Returns ``(blob,
+        n_blocks)`` or None when nothing of the chain is cached."""
+        if self._kv_tier is None:
+            raise EngineError(
+                "KV tiering is disabled (KV_HOST_POOL_TOKENS=0)")
+        tier = self._kv_tier
+        hashes = hash_blocks(list(token_ids), self.cfg.page_size)
+        records: list = []
+        step = max(1, tier.transfer_max_pages)
+        for lo in range(0, len(hashes), step):
+            batch = self._run_control(
+                lambda lo=lo: self._collect_blocks(
+                    hashes, lo, lo + step))
+            records.extend(batch)
+            if len(batch) < min(step, len(hashes) - lo):
+                break   # chain ended mid-slice
+        if not records:
+            return None
+        self._bump("kv_tier_export_pages", len(records))
+        # Blob assembly off the serve loop, on the caller's thread.
+        return kv_tier_mod.to_blob(records, tier.meta), len(records)
 
     def _run(self) -> None:
         """Scheduler thread: retire completions, then execute ROUND PLANS

@@ -368,3 +368,44 @@ def fetch_blocks(url: str, hashes: Sequence[bytes], *,
                        "placing cold", url, timeout_s)
         return None
     return box["result"]
+
+
+def push_blob(url: str, blob: bytes, *, timeout_s: float = 5.0) -> bool:
+    """Push a serialized block chain to a sibling replica's
+    ``POST /control/kv_resume`` — the push-on-completion handoff leg of
+    prefill/decode disaggregation (docs/disaggregation.md). Returns True
+    when the receiver accepted the blob; False on ANY failure — timeout,
+    connection error, receiver rejection. Same bounded-worker discipline
+    as :func:`fetch_blocks` (same ``kv.transfer`` fault point): a hung
+    receiver costs the pusher exactly ``timeout_s``, and the decode side
+    then recomputes the prefix cold — degraded, never wrong."""
+    if not blob:
+        return False
+    box: dict = {}
+
+    def work() -> None:
+        try:
+            faults.inject("kv.transfer")
+            import requests
+            resp = requests.post(
+                url.rstrip("/") + "/control/kv_resume",
+                data=blob,
+                headers={"Content-Type": "application/octet-stream"},
+                timeout=timeout_s)
+            box["result"] = resp.status_code == 200
+        except Exception as exc:  # noqa: BLE001 — push is best-effort
+            box["error"] = exc
+
+    t = threading.Thread(target=work, daemon=True,
+                         name="kv-transfer-push")
+    t.start()
+    t.join(timeout_s)
+    if "error" in box:
+        logger.debug("kv handoff push to %s failed: %s", url,
+                     box["error"])
+        return False
+    if "result" not in box:   # still running: hung receiver
+        logger.warning("kv handoff push to %s timed out after %.1fs; "
+                       "decode side will recompute", url, timeout_s)
+        return False
+    return bool(box["result"])

@@ -244,6 +244,23 @@ class StepCostModel:
         return self.restore_ms(pages) \
             < pages * page_size * self.prefill_ms_per_token
 
+    def handoff_cheaper(self, pages: int, page_size: int) -> bool:
+        """The disaggregation pricing rule: is shipping ``pages``
+        finished prefix pages donor-device → host → wire → host →
+        decode-device priced cheaper than the decode replica recomputing
+        their tokens through prefill? The handoff pays BOTH transfer
+        legs (``d2h`` on the donor, ``h2d`` on the receiver); unmeasured
+        legs (0) answer True, mirroring :meth:`restore_cheaper` — the
+        handoff is assumed to win until the calibrator has real
+        transfer measurements."""
+        if pages <= 0:
+            return False
+        per_page = self.d2h_ms_per_page + self.h2d_ms_per_page
+        if per_page <= 0:
+            return True
+        return pages * per_page \
+            < pages * page_size * self.prefill_ms_per_token
+
 
 def derive_round_budget(model: StepCostModel, steps_per_round: int,
                         page_size: int) -> int:

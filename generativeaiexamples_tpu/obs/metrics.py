@@ -1,12 +1,15 @@
 """First-party metrics: counters, gauges, histograms (with labels),
 TTFT/TPS request timing.
 
-The reference exposes only Triton's own :8002 metrics port and has a
-"TODO: metrics" in the operator (reference: docker-compose.yaml:13-19,
-helmpipeline_controller.go:109) — no app-level registry at all. This module
-fixes that gap: process-wide registry, Prometheus text rendering, and a
-RequestTimer capturing the serving metrics that matter (time-to-first-token,
-tokens/sec) per request class.
+This module is the process-wide metrics registry every surface in the
+repo publishes through: the engine's stats mirror
+(``record_engine_stats``), the round-telemetry gauges (``obs/rounds.py``),
+the chain server's request timers, and the fleet router's ``router_*``
+table (``router/metrics.py``) all render from the one ``REGISTRY`` —
+Prometheus text exposition plus a RequestTimer capturing the serving
+metrics that matter (time-to-first-token, tokens/sec) per request class.
+(The upstream reference this repo grew from exposed only Triton's :8002
+port with no app-level registry; that gap closed in PR 1.)
 
 Label support: a metric declared with ``labelnames`` is a parent whose
 ``labels(...)`` returns (and memoizes) a child per label-value tuple —

@@ -366,7 +366,8 @@ class LocalExecutor:
             await self.router._probe(rep)
             added.append(name)
         while current - len(removed) > target:
-            name = victim or self.router.table.scale_down_candidate()
+            name = victim or self.router.table.scale_down_candidate(
+                exclude_roles=("prefill",))
             victim = None
             if name is None:
                 break
@@ -487,6 +488,11 @@ class AutoscaleController:
             "slo_attainment": fleet.get("slo_attainment"),
             "ttft_p50_ms": fleet.get("ttft_p50_ms"),
             "surge_queue_depth": len(self.surge._waiters),
+            # Disaggregation role census (docs/disaggregation.md): a
+            # role-ful fleet's capacity is per-pool, and the decision
+            # record must show WHICH pool the evidence describes — a
+            # role-less fleet reads {"unified": N}.
+            "roles": dict(fleet.get("roles") or {}),
         }
 
     def _up_reasons(self, ev: dict) -> list[str]:
@@ -594,7 +600,12 @@ class AutoscaleController:
         if action in ("scale_up", "scale_down"):
             victim = None
             if action == "scale_down":
-                victim = self.router.table.scale_down_candidate()
+                # Never drain the prefill pool on a quiet-fleet signal:
+                # the quiet evidence is DECODE-side, and losing the only
+                # prefill replica kills every in-flight handoff leg
+                # (docs/disaggregation.md).
+                victim = self.router.table.scale_down_candidate(
+                    exclude_roles=("prefill",))
                 if victim is None:
                     action, reason = "blocked", ("no drainable scale-down "
                                                  f"candidate ({reason})")
@@ -741,6 +752,7 @@ EVIDENCE_SCHEMA: dict[str, list[str]] = {
     "slo_attainment": ["num", "null"],
     "ttft_p50_ms": ["num", "null"],
     "surge_queue_depth": ["int"],
+    "roles": ["obj"],
 }
 
 #: The ``surge`` sub-block.

@@ -76,12 +76,14 @@ FLEET_TOTALS_SCHEMA: dict[str, list[str]] = {
     "headroom_tokens_per_sec": ["num"],
     "prefix_hit_rate": ["num", "null"],
     "kv_tier_host_pages": ["int"],
+    "roles": ["obj"],
 }
 
 #: One per-replica row.
 FLEET_REPLICA_SCHEMA: dict[str, list[str]] = {
     "name": ["str"],
     "url": ["str"],
+    "role": ["str"],
     "placeable": ["bool"],
     "reachable": ["bool"],
     "draining": ["bool"],
@@ -171,6 +173,7 @@ def build_fleet_snapshot(table: ReplicaTable, slo: SloWindow, *,
         rows.append({
             "name": r["name"],
             "url": r["url"],
+            "role": str(r.get("role", "unified") or "unified"),
             "placeable": bool(r["placeable"]),
             "reachable": bool(r["reachable"]),
             "draining": bool(r["draining"]),
@@ -204,9 +207,17 @@ def build_fleet_snapshot(table: ReplicaTable, slo: SloWindow, *,
         fleet_host_pages += int(kv.get("host_pages", 0) or 0)
         if load.get("prefix_hit_rate") is not None:
             hit_rates.append(float(load["prefix_hit_rate"]))
+    roles: dict[str, int] = {}
+    for r in reps:
+        role = str(r.get("role", "unified") or "unified")
+        roles[role] = roles.get(role, 0) + 1
     fleet = {
         "replicas_total": len(reps),
         "replicas_placeable": sum(1 for r in reps if r["placeable"]),
+        # Disaggregation role census (docs/disaggregation.md): how many
+        # replicas advertise each role — a role-less fleet reads
+        # {"unified": N}.
+        "roles": roles,
         "in_flight": fleet_in_flight,
         "queue_depth": fleet_queue,
         "window_requests": int(total_row.get("requests", 0)),

@@ -138,6 +138,26 @@ ROUTER_METRICS: dict[str, tuple[str, tuple[str, ...], str]] = {
         "queue — nonzero only while the fleet is at max_replicas and "
         "overloaded; sustained depth near ROUTER_SURGE_QUEUE_CAP means "
         "the fleet ceiling itself is too low"),
+    "router_replicas_role": (
+        "gauge", ("role",),
+        "replicas in the table by disaggregation role (unified / "
+        "prefill / decode) as last heartbeat-advertised — a role-less "
+        "fleet reads all-unified (docs/disaggregation.md)"),
+    "router_disagg_handoffs_total": (
+        "counter", (),
+        "long prompts served through the two-leg disaggregated "
+        "prefill/decode handoff: prefill-role replica ran the prompt "
+        "and pushed its finished prefix pages to the chosen decode "
+        "replica, which then admitted the request as a near-full "
+        "prefix-cache hit (docs/disaggregation.md)"),
+    "router_disagg_fallbacks_total": (
+        "counter", ("reason",),
+        "disaggregation handoffs abandoned in favor of normal in-place "
+        "placement, by reason: prefill_error (leg-1 POST failed or "
+        "non-200), prefill_timeout (leg-1 exceeded "
+        "ROUTER_DISAGG_PREFILL_TIMEOUT_S), no_pages (prefill replica "
+        "exported nothing) — each one served correctly via recompute, "
+        "just without the TTFT win"),
 }
 
 

@@ -53,6 +53,20 @@ a rolling per-replica SLO window; and ``GET /debug/fleet``
 (``router/fleet.py``) folds heartbeat state, round aggregates, KV-tier
 counters, the SLO window, and a step-cost-model capacity-headroom
 estimate into the one snapshot an autoscaler or operator reads.
+
+**Disaggregated prefill/decode** (docs/disaggregation.md): when the
+fleet advertises a ``prefill``-role replica, long ``/generate`` prompts
+(>= ``ROUTER_DISAGG_MIN_PROMPT_BYTES``, no retrieval) take a two-leg
+path the router conducts: leg 1 POSTs the body to the prefill replica's
+``/control/prefill`` with ``X-KV-Push-To`` naming the already-chosen
+decode replica, which prefills and pushes the finished prefix pages
+host-to-host; leg 2 forwards the request pinned to that decode replica
+with ``X-KV-Transfer-From`` as the pull fallback, so it admits as a
+near-full prefix-cache hit. The handoff is priced first
+(``table.handoff_beats_prefill`` against the decode replica's
+heartbeat-advertised step-cost model) and every leg-1 failure falls
+back to normal in-place placement — recompute, never an error frame.
+A role-less fleet never enters this path.
 """
 
 from __future__ import annotations
@@ -75,7 +89,7 @@ from . import autoscale as router_autoscale
 from . import fleet as router_fleet
 from . import metrics as router_metrics
 from .flight import RouterFlightRecorder
-from .table import ReplicaTable
+from .table import ReplicaTable, handoff_beats_prefill
 
 logger = get_logger(__name__)
 
@@ -154,6 +168,8 @@ class FleetRouter:
                  forward_timeout_s: float = 300.0,
                  kv_transfer: bool = False,
                  kv_transfer_min_blocks: int = 2,
+                 disagg_min_prompt_bytes: int = 4096,
+                 disagg_prefill_timeout_s: float = 30.0,
                  heartbeat_jitter: float = 0.2,
                  flight: Optional[RouterFlightRecorder] = None,
                  surge: Optional[router_autoscale.SurgeGate] = None):
@@ -175,6 +191,13 @@ class FleetRouter:
         # the replicas; the hint is ignored where tiering is off.
         self.kv_transfer = bool(kv_transfer)
         self.kv_transfer_min_blocks = max(1, int(kv_transfer_min_blocks))
+        # Disaggregated prefill/decode (docs/disaggregation.md): the
+        # enable gate is the FLEET — the handoff path only triggers
+        # when a prefill-role replica is placeable, so a role-less
+        # fleet routes byte-for-byte as before. These knobs only tune
+        # when a role-ful fleet bothers with the two-leg dance.
+        self.disagg_min_prompt_bytes = max(1, int(disagg_min_prompt_bytes))
+        self.disagg_prefill_timeout_s = float(disagg_prefill_timeout_s)
         # Sweep desynchronization: each heartbeat cycle sleeps
         # heartbeat_s * U(1-j, 1+j), so N routers polling one fleet (or
         # one router's restarts) never phase-lock their probe bursts.
@@ -404,6 +427,10 @@ class FleetRouter:
             blocks = self.table.affinity_blocks(
                 affinity_text(request.path, body if isinstance(body, dict)
                               else {}))
+            handed = await self._try_disagg(request, raw, body, blocks,
+                                            tl)
+            if handed is not None:
+                return handed
             return await self._forward_attempts(request, raw, blocks, tl)
         except asyncio.CancelledError:
             # Caller hung up while we were placing/connecting/streaming:
@@ -417,9 +444,90 @@ class FleetRouter:
         finally:
             self.surge.exit(ticket)
 
+    async def _try_disagg(self, request: web.Request, raw: bytes,
+                          body, blocks: Sequence[bytes],
+                          tl) -> Optional[web.StreamResponse]:
+        """The disaggregated prefill/decode handoff, or None to take
+        the normal path (docs/disaggregation.md).
+
+        Eligibility: a ``/generate`` body at least
+        ``disagg_min_prompt_bytes`` long, no retrieval (the replica
+        augments the prompt server-side, so the router cannot pre-run
+        it on a different chip), a placeable prefill-role replica, and
+        the priced rule saying moving the finished pages beats
+        re-prefilling on the decode replica. The decode replica is
+        chosen FIRST — the prefill replica pushes straight to it — and
+        every leg-1 failure degrades to plain placement on that same
+        replica: recompute costs TTFT, never correctness."""
+        if request.path != "/generate" or not isinstance(body, dict):
+            return None
+        if body.get("use_knowledge_base"):
+            return None
+        if len(raw) < self.disagg_min_prompt_bytes:
+            return None
+        prefill = self.table.prefill_candidate()
+        if prefill is None:
+            return None
+        rep, decision = self.table.place_explained(blocks)
+        if rep is None:
+            return None
+        pinned = (rep, decision)
+        if not handoff_beats_prefill(rep.capacity, len(raw)):
+            # Priced out (tiny pages / fast prefill): same placement,
+            # no handoff leg. Reuse the decision — re-placing would
+            # double-count the selection.
+            return await self._forward_attempts(request, raw, blocks,
+                                                tl, pinned=pinned)
+        reason = ""
+        t0 = time.monotonic()
+        try:
+            assert self._session is not None
+            async with self._session.post(
+                    prefill.url + "/control/prefill", data=raw,
+                    headers={"X-KV-Push-To": rep.url,
+                             "X-Request-ID": tl.request_id,
+                             "Content-Type": "application/json"},
+                    timeout=aiohttp.ClientTimeout(
+                        total=self.disagg_prefill_timeout_s)) as up:
+                if up.status == 200:
+                    try:
+                        info = await up.json()
+                    except Exception:  # noqa: BLE001 — not the contract
+                        info = {}
+                    if int(info.get("blocks", 0) or 0) > 0 \
+                            and info.get("pushed"):
+                        prefill.breaker.record_success()
+                    else:
+                        reason = "no_pages"
+                else:
+                    reason = "prefill_error"
+        except asyncio.CancelledError:
+            raise
+        except asyncio.TimeoutError:
+            reason = "prefill_timeout"
+        except Exception as exc:  # noqa: BLE001 — any leg-1 failure
+            logger.info("disagg prefill leg via %s failed (%s); "
+                        "falling back to recompute", prefill.name, exc)
+            reason = "prefill_error"
+        tl.stage("router_disagg_prefill", time.monotonic() - t0)
+        if reason:
+            router_metrics.counter(
+                "router_disagg_fallbacks_total", reason).inc()
+            tl.event("disagg_fallback", f"{prefill.name}:{reason}")
+            return await self._forward_attempts(request, raw, blocks,
+                                                tl, pinned=pinned)
+        router_metrics.counter("router_disagg_handoffs_total").inc()
+        tl.event("disagg_handoff", prefill.name)
+        return await self._forward_attempts(
+            request, raw, blocks, tl, pinned=pinned,
+            donor_override=prefill.url)
+
     async def _forward_attempts(self, request: web.Request, raw: bytes,
                                 blocks: Sequence[bytes],
-                                tl) -> web.StreamResponse:
+                                tl, *,
+                                pinned: Optional[tuple] = None,
+                                donor_override: Optional[str] = None
+                                ) -> web.StreamResponse:
         rid = tl.request_id
         fwd_headers = {"X-Request-ID": rid}
         for h in _FORWARD_HEADERS:
@@ -432,8 +540,16 @@ class FleetRouter:
         fallback_rep = ""
         for _ in range(self.retry_attempts):
             t_place = time.monotonic()
-            rep, decision = self.table.place_explained(blocks,
-                                                       exclude=tried)
+            if pinned is not None:
+                # Disagg handoff (docs/disaggregation.md): the decode
+                # replica was chosen BEFORE the prefill leg so the pages
+                # could be pushed to it — first attempt lands there;
+                # retries fall back to normal placement.
+                rep, decision = pinned
+                pinned = None
+            else:
+                rep, decision = self.table.place_explained(blocks,
+                                                           exclude=tried)
             if rep is None:
                 break
             tried.append(rep.name)
@@ -442,7 +558,14 @@ class FleetRouter:
             # donor depends on who was chosen.
             fwd_headers.pop("X-KV-Transfer-From", None)
             donor: Optional[str] = None
-            if self.kv_transfer and blocks:
+            if donor_override is not None:
+                # The handoff's pull fallback: if the prefill replica's
+                # push raced admission, the decode replica fetches the
+                # pages from it by the ordinary transfer leg.
+                donor = donor_override
+                fwd_headers["X-KV-Transfer-From"] = donor
+                donor_override = None
+            elif self.kv_transfer and blocks:
                 donor = self.table.transfer_donor(
                     blocks, chosen=rep.name,
                     min_blocks=self.kv_transfer_min_blocks)
@@ -702,7 +825,10 @@ def create_router_app(replicas: Sequence[tuple[str, str]] = (), *,
     ``ROUTER_SKETCH_CAP``, ``ROUTER_BREAKER_FAILURES`` /
     ``ROUTER_BREAKER_COOLDOWN_S``, ``ROUTER_CONNECT_TIMEOUT_S`` /
     ``ROUTER_FORWARD_TIMEOUT_S``, ``ROUTER_KV_TRANSFER`` /
-    ``ROUTER_KV_TRANSFER_MIN_BLOCKS`` (docs/router.md), and the
+    ``ROUTER_KV_TRANSFER_MIN_BLOCKS`` (docs/router.md),
+    ``ROUTER_DISAGG_MIN_PROMPT_BYTES`` /
+    ``ROUTER_DISAGG_PREFILL_TIMEOUT_S`` (docs/disaggregation.md), and
+    the
     autoscaler/surge knobs (``ROUTER_AUTOSCALE*`` / ``ROUTER_SURGE_*``,
     docs/autoscaling.md). ``autoscale_factory`` builds a controller
     bound to the finished router (``factory(router) -> controller``);
@@ -735,6 +861,10 @@ def create_router_app(replicas: Sequence[tuple[str, str]] = (), *,
                      not in ("", "0", "false", "off")),
         kv_transfer_min_blocks=int(
             _env_float("ROUTER_KV_TRANSFER_MIN_BLOCKS", 2)),
+        disagg_min_prompt_bytes=int(
+            _env_float("ROUTER_DISAGG_MIN_PROMPT_BYTES", 4096)),
+        disagg_prefill_timeout_s=_env_float(
+            "ROUTER_DISAGG_PREFILL_TIMEOUT_S", 30.0),
         heartbeat_jitter=_env_float("ROUTER_HEARTBEAT_JITTER", 0.2))
 
     if autoscale is None and autoscale_factory is not None:

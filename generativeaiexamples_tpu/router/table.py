@@ -84,6 +84,12 @@ class Replica:
     rounds: dict = field(default_factory=dict)
     kv_tier: dict = field(default_factory=dict)
     capacity: dict = field(default_factory=dict)
+    # Disaggregation role, heartbeat-advertised (chains/server.py
+    # /health): "unified" (the default — also what replicas that never
+    # send a role resolve to, so a role-less fleet places byte-for-byte
+    # like today), "prefill" (excluded from normal placement; the
+    # router's handoff leg targets it directly), or "decode".
+    role: str = "unified"
     recent_rejects: float = 0.0    # rejected_total diff between heartbeats
     last_heartbeat_t: float = 0.0
     heartbeat_failures: int = 0    # probes that got no HTTP answer at all
@@ -106,6 +112,7 @@ class Replica:
             "reachable": self.reachable, "ready": self.ready,
             "draining": self.draining,
             "breaker": self.breaker.state, "placeable": self.placeable(),
+            "role": self.role,
             "load": dict(self.load),
             "rounds": dict(self.rounds),
             "kv_tier": dict(self.kv_tier),
@@ -251,8 +258,16 @@ class ReplicaTable:
         replica's leading-block match — computed under the same lock as
         the choice, so the explanation is exactly what the scorer saw."""
         with self._lock:
+            # Prefill-role replicas never take normal traffic: their
+            # admission rejects decode-bound requests anyway (engine
+            # RoleMismatchError), so offering them here would only buy
+            # retries. The router reaches them exclusively through the
+            # handoff leg (FleetRouter._disagg_handoff). A role-less
+            # fleet has no prefill replicas and this filter matches
+            # nothing — placement is byte-for-byte today's.
             candidates = [r for r in self._replicas.values()
-                          if r.name not in exclude and r.placeable()]
+                          if r.name not in exclude and r.placeable()
+                          and r.role != "prefill"]
             decision: dict = {"policy": self.policy,
                               "excluded": list(exclude),
                               "candidates": []}
@@ -339,6 +354,13 @@ class ReplicaTable:
                 rep.heartbeat_failures += 1
             if ok and body is not None:
                 rep.draining = bool(body.get("draining", False))
+                # Role defaults to "unified" when the heartbeat body
+                # carries no role key (older replicas, engineless
+                # chains) — a role-less fleet must behave exactly like
+                # today's.
+                role = str(body.get("role") or "unified")
+                rep.role = role if role in ("unified", "prefill",
+                                            "decode") else "unified"
                 # Fleet-observability blocks ride the same heartbeat;
                 # absent blocks (engineless chains, older replicas)
                 # clear so /debug/fleet never shows stale telemetry.
@@ -383,23 +405,48 @@ class ReplicaTable:
                 "router_heartbeat_age_seconds", rep.name).set(
                 round(age, 3))
 
-    def scale_down_candidate(self,
-                             exclude: Sequence[str] = ()) -> Optional[str]:
+    def scale_down_candidate(self, exclude: Sequence[str] = (),
+                             exclude_roles: Sequence[str] = ()
+                             ) -> Optional[str]:
         """The replica a scale-down should drain first: the PLACEABLE
         one with the least in-flight work (fewest edge streams, then
         shallowest queue, then fewest lifetime placements — the
         cheapest drain and the smallest affinity-sketch loss). Draining
         or dead replicas are never proposed (they are already leaving
-        or already gone); None when no placeable replica remains."""
+        or already gone); ``exclude_roles`` lets the autoscaler protect
+        a pool (draining the only prefill replica over a quiet DECODE
+        signal would kill every in-flight handoff); None when no
+        eligible replica remains."""
         with self._lock:
             candidates = [r for r in self._replicas.values()
-                          if r.name not in exclude and r.placeable()]
+                          if r.name not in exclude and r.placeable()
+                          and r.role not in exclude_roles]
             if not candidates:
                 return None
             return min(candidates, key=lambda r: (
                 int(r.load.get("in_flight", 0)),
                 int(r.load.get("queue_depth", 0)),
                 r.placements, r.name)).name
+
+    def prefill_candidate(self) -> Optional[Replica]:
+        """The prefill-role replica a handoff leg should target: the
+        least-loaded placeable one (shallowest queue, then fewest
+        in-flight, then fewest selections so equal-load prefill
+        replicas rotate). None when the fleet has no placeable prefill
+        replica — the router then serves the long prompt in place
+        (chunked prefill on the chosen decode/unified replica), which
+        is exactly today's behavior."""
+        with self._lock:
+            candidates = [r for r in self._replicas.values()
+                          if r.placeable() and r.role == "prefill"]
+            if not candidates:
+                return None
+            chosen = min(candidates, key=lambda r: (
+                int(r.load.get("queue_depth", 0)),
+                int(r.load.get("in_flight", 0)),
+                r.selections, r.name))
+            chosen.selections += 1
+            return chosen
 
     def mark_unreachable(self, name: str) -> None:
         with self._lock:
@@ -421,6 +468,39 @@ class ReplicaTable:
             healthy = sum(1 for r in reps if r.placeable())
             drain_in_flight = sum(
                 int(r.load.get("in_flight", 0)) for r in reps if r.draining)
+            by_role = {role: sum(1 for r in reps if r.role == role)
+                       for role in ("unified", "prefill", "decode")}
         router_metrics.gauge("router_replicas_total").set(len(reps))
         router_metrics.gauge("router_replicas_healthy").set(healthy)
         router_metrics.gauge("router_drain_in_flight").set(drain_in_flight)
+        for role, n in by_role.items():
+            router_metrics.gauge("router_replicas_role", role).set(n)
+
+
+def handoff_beats_prefill(capacity: Optional[dict], prompt_bytes: int,
+                          bytes_per_token: float = 4.0) -> bool:
+    """The router-side disaggregation pricing rule: does shipping this
+    prompt's finished prefix pages (prefill replica → decode replica,
+    both transfer legs) beat the decode replica chunk-prefilling it in
+    place? ``capacity`` is the DECODE replica's heartbeat capacity
+    block (chains/server.py) — the same calibrated
+    ``prefill_ms_per_token`` / ``h2d``/``d2h`` per-page costs its own
+    engine prices restores with; ``prompt_bytes`` is the router's only
+    length signal (no tokenizer), converted at a coarse
+    ``bytes_per_token``. Unmeasured transfer legs (0 — the calibrator
+    has no evidence yet) answer True, mirroring
+    ``StepCostModel.restore_cheaper``; an unmeasured prefill cost with
+    MEASURED transfer legs answers False (recompute is priced free —
+    nothing to beat)."""
+    cap = capacity or {}
+    page_size = max(1, int(cap.get("page_size", 128) or 128))
+    est_tokens = max(1, int(prompt_bytes / max(1.0, bytes_per_token)))
+    pages = max(1, -(-est_tokens // page_size))
+    per_page = (float(cap.get("d2h_ms_per_page", 0.0) or 0.0)
+                + float(cap.get("h2d_ms_per_page", 0.0) or 0.0))
+    if per_page <= 0:
+        return True
+    prefill_ms = float(cap.get("prefill_ms_per_token", 0.0) or 0.0)
+    if prefill_ms <= 0:
+        return False
+    return pages * per_page < est_tokens * prefill_ms

@@ -1,0 +1,125 @@
+"""Tier-1 CPU smoke of the disaggregation bench scenario: unified vs
+1-prefill + (N-1)-decode at EQUAL chips, over real tiny-engine
+replicas and a real router handoff leg, plus the schema contract for
+the new ``disagg`` section (the ``disagg.*@<arm>`` metrics that
+``tools/perf_diff.py`` gates on).
+
+Timing comparisons between the two arms are deliberately NOT asserted
+here — on a CPU tier-1 box the arms are separated by scheduling noise,
+not by chip physics. What IS pinned: the disagg arm actually hands
+off (handoffs > 0, exported pages > 0) while the unified arm never
+enters the disagg path at all."""
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import bench
+from generativeaiexamples_tpu.models import llama
+from generativeaiexamples_tpu.models.configs import LlamaConfig
+from generativeaiexamples_tpu.models.tokenizer import ByteTokenizer
+from tools.check_bench_schema import (BenchSchemaError, load_schema,
+                                      validate_result)
+
+CFG = LlamaConfig(vocab_size=259 + 5, hidden_size=64,
+                  intermediate_size=128, num_layers=2, num_heads=4,
+                  num_kv_heads=2, head_dim=16,
+                  max_position_embeddings=1024)
+
+
+@pytest.fixture(scope="module")
+def disagg_section():
+    # Long prompts must clear the router's handoff gate; the default
+    # 4096-byte floor would need huge prompts, so lower it for the
+    # tiny-model smoke and size long/short either side of 512 bytes.
+    import os
+    overrides = {
+        "ROUTER_DISAGG_MIN_PROMPT_BYTES": "512",
+        # A saturated CPU box grinds multi-second rounds on every
+        # replica at once; the chip-default 5 s page-push bound turns
+        # real handoffs into no_pages fallbacks here, so widen both
+        # handoff timeouts — the smoke pins the path, not the latency.
+        "KV_TRANSFER_TIMEOUT_S": "30",
+        "ROUTER_DISAGG_PREFILL_TIMEOUT_S": "120",
+    }
+    prev = {k: os.environ.get(k) for k in overrides}
+    os.environ.update(overrides)
+    try:
+        # build_fleet_engines allocates replica KV pools in bfloat16;
+        # params must match or the KV scatter rejects the dtype mix.
+        params = llama.init_params(CFG, jax.random.key(13),
+                                   dtype=jnp.bfloat16)
+        # max_input_length=1024 (vs the chip default 4096): prewarm
+        # serves a worst-case full-length prompt per replica, and four
+        # 4096-token CPU prefills would dominate the tier-1 budget.
+        yield bench.run_disagg_bench(
+            params, CFG, ByteTokenizer(), replicas=2, requests=6,
+            rps=8.0, long_frac=0.5, long_chars=700, short_chars=120,
+            num_tokens=4, seed=3, heartbeat_s=0.3,
+            max_input_length=1024)
+    finally:
+        for key, value in prev.items():
+            if value is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = value
+
+
+def _synthetic_with(disagg):
+    pipeline = bench.pipeline_snapshot({})
+    return bench.assemble_result(
+        kind="engine", model="llama-tiny", headline=10.0,
+        engine_p50=8.0, engine_p99=12.0, tput=100.0,
+        achieved_bw=1e9, bw_util=0.1, bw_steady=True,
+        chat=None, e2e_p50=None, e2e_dist=None, e2e_breakdown=None,
+        e2e_tps_p50=None, pipeline=pipeline, quant="none", kv_quant=None,
+        weights="random-init", prompt_len=16, out_len=4, slots=2,
+        steps_per_round=4, kv_pool_pages=8, device="cpu", rtt_ms=None,
+        n_devices=1, bench_seconds=1.0, disagg=disagg)
+
+
+def test_disagg_bench_end_to_end(disagg_section):
+    section = disagg_section
+    assert section["replicas"] == 2
+    assert [a["arm"] for a in section["arms"]] == ["unified", "disagg"]
+    for arm in section["arms"]:
+        assert arm["offered"] == 6
+        assert arm["errors"] == 0 and arm["completed"] == 6
+        assert arm["ttft_p50_ms"] > 0
+        assert arm["long_ttft_p50_ms"] > 0
+        assert arm["short_ttft_p50_ms"] > 0
+        assert arm["decode_goodput"] > 0
+        assert arm["tokens_generated"] > 0
+    unified, disagg = section["arms"]
+    # the unified baseline is honest: all-unified roles, no handoffs
+    assert unified["roles"] == {"unified": 2}
+    assert unified["handoffs"] == 0
+    assert unified["kv_export_pages"] == 0
+    # the disagg arm really disaggregated: same chip count split into
+    # roles, every long prompt handed off through the prefill replica
+    assert disagg["roles"] == {"prefill": 1, "decode": 1}
+    assert disagg["handoffs"] >= 1
+    assert disagg["kv_export_pages"] > 0
+    assert disagg["fallbacks"] + disagg["handoffs"] >= 1
+
+
+def test_disagg_section_schema_valid(disagg_section):
+    validate_result(_synthetic_with(disagg_section))
+    validate_result(_synthetic_with(None))  # disagg-less runs still pass
+
+
+def test_disagg_section_matches_schema_keys(disagg_section):
+    schema = load_schema()
+    assert set(disagg_section) == set(schema["disagg"])
+    for arm in disagg_section["arms"]:
+        assert set(arm) == set(schema["disagg_arm"])
+
+
+def test_disagg_arm_field_rename_fails_fast(disagg_section):
+    import copy
+    section = copy.deepcopy(disagg_section)
+    section["arms"][1]["goodput"] = \
+        section["arms"][1].pop("decode_goodput")
+    with pytest.raises(BenchSchemaError, match=r"disagg\.arms\[1\]"):
+        validate_result(_synthetic_with(section))

@@ -170,3 +170,24 @@ def test_spec_snapshot_none_without_verify_rounds():
     assert bench.spec_snapshot({}, {}) is None
     assert bench.spec_snapshot({"spec_verify_rounds": 4},
                                {"spec_verify_rounds": 4}) is None
+
+
+def test_disagg_section_contract_pinned():
+    """The disagg section (docs/disaggregation.md) is validated
+    element-wise per arm: the synthetic section's keys ARE the schema's
+    disagg/disagg_arm sections, a rename inside an arm fails fast with
+    the arm's index, and disagg: null (scenario off) stays valid."""
+    from tools.preflight import synthetic_disagg
+
+    schema = load_schema()
+    section = synthetic_disagg()
+    assert set(section) == set(schema["disagg"])
+    for arm in section["arms"]:
+        assert set(arm) == set(schema["disagg_arm"])
+    result = synthetic_result()
+    validate_result(dict(result, disagg=section))
+    validate_result(dict(result, disagg=None))
+    broken = synthetic_disagg()
+    broken["arms"][1]["goodput"] = broken["arms"][1].pop("decode_goodput")
+    with pytest.raises(BenchSchemaError, match=r"disagg\.arms\[1\]"):
+        validate_result(dict(result, disagg=broken))

@@ -310,6 +310,157 @@ def test_chaos_replica_kill_mid_stream_error_frame_and_failover():
 
 
 @pytest.mark.chaos
+def test_chaos_disagg_transfer_hang_degrades_to_recompute(monkeypatch):
+    """FAULT_PLAN kv.transfer=hang mid-handoff: the donor's page push
+    times out, leg 1 reports pushed=false, and the router falls back to
+    a full recompute on the SAME decode replica — token-identical to
+    the unified path, no error frame, and no orphaned host-tier bytes
+    on the decode side."""
+    from generativeaiexamples_tpu.router.server import create_router_app
+    from tests.test_disagg import (_run, _snap, build_engine, long_body,
+                                   replica_app)
+    from tests.test_disagg import params as _params_fixture  # noqa: F401
+
+    monkeypatch.delenv("ENGINE_ROLE", raising=False)
+    monkeypatch.delenv("KV_HOST_POOL_TOKENS", raising=False)
+    monkeypatch.setenv("ROUTER_DISAGG_MIN_PROMPT_BYTES", "400")
+    from tests.test_disagg import CFG as DCFG
+    params = llama.init_params(DCFG, jax.random.key(29),
+                               dtype=jnp.float32)
+    prefill_eng = build_engine(params, role="prefill")
+    prefill_eng._kv_tier.transfer_timeout_s = 0.3
+    decode_eng = build_engine(params, role="decode")
+    unified_eng = build_engine(params)
+    body = long_body("hang-chaos")
+
+    async def fn():
+        ref_server = TestServer(replica_app(unified_eng))
+        p_server = TestServer(replica_app(prefill_eng))
+        d_server = TestServer(replica_app(decode_eng))
+        for s in (ref_server, p_server, d_server):
+            await s.start_server()
+        router_app = create_router_app(
+            [("p0", f"http://127.0.0.1:{p_server.port}"),
+             ("d0", f"http://127.0.0.1:{d_server.port}")],
+            policy="affinity", heartbeat_s=30, kv_transfer=False,
+            run_heartbeat=False)
+        client = TestClient(TestServer(router_app))
+        await client.start_server()
+        ref_client = TestClient(ref_server)
+        try:
+            resp = await ref_client.post("/generate", json=body)
+            assert resp.status == 200
+            reference = (await resp.read()).decode()
+            await client.post("/control/heartbeat")
+
+            h0 = _snap("router_disagg_handoffs_total")
+            f0 = _snap('router_disagg_fallbacks_total'
+                       '{reason="no_pages"}')
+            faults.set_plan("kv.transfer=hang")
+            resp = await client.post("/generate", json=body,
+                                     headers={"X-Request-ID": "hang-1"})
+            assert resp.status == 200
+            assert resp.headers["X-Routed-Replica"] == "d0"
+            answer = (await resp.read()).decode()
+            # degraded to recompute: token-identical, no error frame
+            assert answer == reference
+            assert "[error]" not in answer
+            assert faults.fired("kv.transfer") >= 1
+            assert _snap("router_disagg_handoffs_total") == h0
+            assert _snap('router_disagg_fallbacks_total'
+                         '{reason="no_pages"}') == f0 + 1
+            # the failed push left NOTHING behind on the decode side
+            assert decode_eng.stats["kv_tier_resumed_blocks"] == 0
+            assert decode_eng.stats["kv_tier_host_pages"] == 0
+            # the fallback is visible on the request's timeline
+            dbg = await (await client.get(
+                "/debug/requests?limit=10")).json()
+            tl = next(t for t in dbg["completed"]
+                      if t["request_id"] == "hang-1")
+            assert "disagg_fallback" \
+                in [e["event"] for e in tl["events"]]
+        finally:
+            faults.clear()
+            await client.close()
+            await ref_client.close()
+            for s in (p_server, d_server):
+                await s.close()
+
+    with prefill_eng, decode_eng, unified_eng:
+        _run(fn())
+
+
+@pytest.mark.chaos
+def test_chaos_disagg_prefill_kill_falls_back_token_identical(
+        monkeypatch):
+    """Prefill replica killed mid-handoff (dead before leg 1 connects):
+    the router counts a prefill_error fallback and serves the request
+    by recompute on the pinned decode replica — token-identical, no
+    error frame, caller never sees the kill."""
+    from generativeaiexamples_tpu.router.server import create_router_app
+    from tests.test_disagg import (_run, _snap, build_engine, long_body,
+                                   replica_app)
+
+    monkeypatch.delenv("ENGINE_ROLE", raising=False)
+    monkeypatch.delenv("KV_HOST_POOL_TOKENS", raising=False)
+    monkeypatch.setenv("ROUTER_DISAGG_MIN_PROMPT_BYTES", "400")
+    from tests.test_disagg import CFG as DCFG
+    params = llama.init_params(DCFG, jax.random.key(29),
+                               dtype=jnp.float32)
+    prefill_eng = build_engine(params, role="prefill")
+    decode_eng = build_engine(params, role="decode")
+    unified_eng = build_engine(params)
+    body = long_body("kill-chaos")
+
+    async def fn():
+        ref_server = TestServer(replica_app(unified_eng))
+        p_server = TestServer(replica_app(prefill_eng))
+        d_server = TestServer(replica_app(decode_eng))
+        for s in (ref_server, p_server, d_server):
+            await s.start_server()
+        router_app = create_router_app(
+            [("p0", f"http://127.0.0.1:{p_server.port}"),
+             ("d0", f"http://127.0.0.1:{d_server.port}")],
+            policy="affinity", heartbeat_s=30, kv_transfer=False,
+            run_heartbeat=False)
+        client = TestClient(TestServer(router_app))
+        await client.start_server()
+        ref_client = TestClient(ref_server)
+        try:
+            resp = await ref_client.post("/generate", json=body)
+            assert resp.status == 200
+            reference = (await resp.read()).decode()
+            # the router learns the roles, THEN the prefill pod dies —
+            # the table still lists p0 as placeable when the long
+            # prompt arrives (no heartbeat poller to notice the kill)
+            await client.post("/control/heartbeat")
+            await p_server.close()
+
+            h0 = _snap("router_disagg_handoffs_total")
+            f0 = _snap('router_disagg_fallbacks_total'
+                       '{reason="prefill_error"}')
+            resp = await client.post("/generate", json=body)
+            assert resp.status == 200
+            assert resp.headers["X-Routed-Replica"] == "d0"
+            answer = (await resp.read()).decode()
+            assert answer == reference
+            assert "[error]" not in answer
+            assert _snap("router_disagg_handoffs_total") == h0
+            assert _snap('router_disagg_fallbacks_total'
+                         '{reason="prefill_error"}') == f0 + 1
+            # the decode replica recomputed — nothing was pushed
+            assert decode_eng.stats["kv_tier_resumed_blocks"] == 0
+            assert prefill_eng.stats["kv_tier_export_pages"] == 0
+        finally:
+            await client.close()
+            await ref_client.close()
+            await d_server.close()
+
+    with prefill_eng, decode_eng, unified_eng:
+        _run(fn())
+
+
+@pytest.mark.chaos
 def test_chaos_router_replica_partition_breaker_opens_traffic_shifts():
     """Partition ONE replica from the router (forwards AND heartbeats
     fail at connect for r0 only): every caller request still succeeds on

@@ -1,0 +1,490 @@
+"""Disaggregated prefill/decode chip pools (docs/disaggregation.md).
+
+Role model and placement (tier-1, CPU): a prefill-role engine refuses
+decode-bound work with ``RoleMismatchError`` (retryable 429 at the
+edge, never a breaker trip), the router's placement filter keeps short
+decode-bound requests off prefill-role replicas, a role-less fleet
+places byte-for-byte as before the subsystem existed, and the
+``handoff_beats_prefill`` / ``StepCostModel.handoff_cheaper`` pricing
+rules answer the documented way at every unmeasured edge.
+
+The acceptance pin: two in-process replicas (1 prefill + 1 decode)
+behind the real router over real HTTP — a long ``/generate`` prompt is
+served through the two-leg handoff (prefill leg, KV-page push, decode
+admission as a near-full prefix hit) and the answer is TOKEN-IDENTICAL
+to the same request served by a unified replica."""
+
+import asyncio
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import aiohttp  # noqa: F401 — skip cleanly where aiohttp is absent
+from aiohttp.test_utils import TestClient, TestServer
+
+from generativeaiexamples_tpu.chains.examples.developer_rag import QAChatbot
+from generativeaiexamples_tpu.chains.llm import EngineLLM
+from generativeaiexamples_tpu.chains.server import create_app
+from generativeaiexamples_tpu.embed.encoder import HashEmbedder
+from generativeaiexamples_tpu.engine import (Engine, EngineConfig,
+                                             SamplingParams)
+from generativeaiexamples_tpu.engine.scheduler import StepCostModel
+from generativeaiexamples_tpu.models import llama
+from generativeaiexamples_tpu.models.configs import LlamaConfig
+from generativeaiexamples_tpu.obs import metrics as obs_metrics
+from generativeaiexamples_tpu.models.tokenizer import ByteTokenizer
+from generativeaiexamples_tpu.router.table import (ReplicaTable,
+                                                   handoff_beats_prefill)
+from generativeaiexamples_tpu.utils import faults, resilience
+from generativeaiexamples_tpu.utils.app_config import AppConfig
+from generativeaiexamples_tpu.utils.configuration import from_dict
+from generativeaiexamples_tpu.utils.errors import (ConfigError,
+                                                   RoleMismatchError)
+
+PAGE = 16
+
+CFG = LlamaConfig(vocab_size=259 + 5, hidden_size=64, intermediate_size=128,
+                  num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+                  max_position_embeddings=1024)
+
+APP_CFG = from_dict(AppConfig, {
+    "llm": {"model_engine": "tpu-jax"},
+    "embeddings": {"model_engine": "hash", "dimensions": 32},
+})
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(CFG, jax.random.key(29), dtype=jnp.float32)
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    # Roles and tiering are under TEST control, not ambient env.
+    for var in ("ENGINE_ROLE", "KV_HOST_POOL_TOKENS",
+                "ROLE_PREFILL_MAX_TOKENS", "KV_EXPORT_CONCURRENCY"):
+        monkeypatch.delenv(var, raising=False)
+    faults.clear()
+    resilience.reset_breakers()
+    yield
+    faults.clear()
+    resilience.reset_breakers()
+
+
+def build_engine(params, role="unified", host_tokens=16384):
+    """A tiny tier-enabled engine, chunked prefill, shared by the
+    role/handoff tests here and the disagg chaos tests in
+    tests/test_chaos.py."""
+    cfg = EngineConfig(
+        max_slots=2, max_input_length=1024, max_output_length=32,
+        prefill_buckets=(64,), max_prefill_bucket=64, page_size=PAGE,
+        dtype="float32", kv_pool_tokens=4096, max_queue=32,
+        steps_per_round=4, kv_host_pool_tokens=host_tokens, role=role)
+    return Engine(params, CFG, ByteTokenizer(), cfg)
+
+
+def replica_app(eng):
+    return create_app(QAChatbot(llm=EngineLLM(eng),
+                                embedder=HashEmbedder(dim=32),
+                                config=APP_CFG, fused_rag=False),
+                      config=APP_CFG)
+
+
+def _words(tag: str, n_chars: int) -> str:
+    """Deterministic filler prose (seeded by tag, same scheme as the
+    bench's prompt generator)."""
+    import hashlib
+
+    import numpy as np
+    h = int.from_bytes(hashlib.blake2b(
+        tag.encode(), digest_size=4).digest(), "little")
+    rng = np.random.RandomState(h)
+    toks = []
+    total = 0
+    while total < n_chars:
+        w = "".join(chr(97 + c) for c in rng.randint(0, 26, size=5))
+        toks.append(w)
+        total += 6
+    return " ".join(toks)[:n_chars]
+
+
+def long_body(tag: str, n_chars: int = 550, num_tokens: int = 12) -> dict:
+    return {"question": "What does the passage describe? " + tag,
+            "context": _words(tag, n_chars),
+            "use_knowledge_base": False, "num_tokens": num_tokens}
+
+
+def _snap(name: str) -> float:
+    return obs_metrics.REGISTRY.snapshot().get(name, 0.0)
+
+
+def _run(coro):
+    loop = asyncio.get_event_loop_policy().new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+# ------------------------------------------------------------ role model
+
+def test_engine_config_rejects_unknown_role():
+    with pytest.raises(ConfigError, match="role"):
+        EngineConfig(role="prefix")
+
+
+def test_engine_role_env_beats_config(params, monkeypatch):
+    monkeypatch.setenv("ENGINE_ROLE", "prefill")
+    eng = build_engine(params, role="unified")
+    assert eng.role == "prefill"
+    monkeypatch.setenv("ENGINE_ROLE", "bogus")
+    with pytest.raises(ConfigError, match="ENGINE_ROLE"):
+        build_engine(params)
+
+
+def test_prefill_role_engine_rejects_decode_bound_work(params,
+                                                       monkeypatch):
+    """A prefill-role engine admits prefill-shaped requests (tiny
+    max_tokens) and refuses decode-bound ones at submit() — before any
+    queue or slot state changes — with the typed routing error."""
+    monkeypatch.setenv("ROLE_PREFILL_MAX_TOKENS", "2")
+    eng = build_engine(params, role="prefill")
+    prompt = [7] * (2 * PAGE)
+    with eng:
+        ok = eng.submit(prompt, SamplingParams(max_tokens=1, top_k=1,
+                                               ignore_eos=True))
+        ok.text()
+        assert ok.finish_reason == "length"
+        with pytest.raises(RoleMismatchError, match="prefill-role"):
+            eng.submit(prompt, SamplingParams(max_tokens=8, top_k=1))
+    # unified engines never hit the cap, whatever the env says
+    uni = build_engine(params, role="unified")
+    with uni:
+        stream = uni.submit(prompt, SamplingParams(max_tokens=4, top_k=1,
+                                                   ignore_eos=True))
+        stream.text()
+        assert stream.finish_reason == "length"
+
+
+# ----------------------------------------------------- role-aware table
+
+def _table_with_roles():
+    table = ReplicaTable()
+    table.add("p0", "http://p0")
+    table.add("d0", "http://d0")
+    table.add("d1", "http://d1")
+    table.update_health("p0", ok=True, ready=True,
+                        body={"role": "prefill"})
+    table.update_health("d0", ok=True, ready=True,
+                        body={"role": "decode"})
+    table.update_health("d1", ok=True, ready=True,
+                        body={"role": "decode"})
+    return table
+
+
+def test_prefill_replicas_never_take_normal_placements():
+    """Satellite: short decode-bound requests NEVER land on a
+    prefill-role replica, even with the decode pool loaded and the
+    prefill replica idle."""
+    table = _table_with_roles()
+    for rep in ("d0", "d1"):
+        table.update_health(rep, ok=True, ready=True, body={
+            "role": "decode",
+            "load": {"in_flight": 5, "queue_depth": 9}})
+    for _ in range(16):
+        rep, decision = table.place_explained(())
+        assert rep is not None and rep.name != "p0"
+        assert all(c["replica"] != "p0"
+                   for c in decision["candidates"])
+    # ... and the retry loop cannot reach it either
+    rep = table.place((), exclude=("d0", "d1"))
+    assert rep is None
+
+
+def test_prefill_candidate_selection_and_rotation():
+    table = _table_with_roles()
+    assert table.prefill_candidate().name == "p0"
+    table.add("p1", "http://p1")
+    table.update_health("p1", ok=True, ready=True,
+                        body={"role": "prefill"})
+    picks = {table.prefill_candidate().name for _ in range(4)}
+    assert picks == {"p0", "p1"}          # equal-load rotation
+    table.update_health("p0", ok=True, ready=True, body={
+        "role": "prefill", "load": {"queue_depth": 7, "in_flight": 2}})
+    assert table.prefill_candidate().name == "p1"  # least-loaded wins
+    table.mark_unreachable("p1")
+    table.mark_draining("p0")
+    assert table.prefill_candidate() is None
+    # heartbeats that stop carrying a role demote to unified; bogus
+    # roles are rejected at the parse, not trusted into placement
+    table.update_health("d0", ok=True, ready=True, body={})
+    table.update_health("d1", ok=True, ready=True, body={"role": "wat"})
+    snap = {r["name"]: r["role"] for r in table.snapshot()}
+    assert snap["d0"] == "unified" and snap["d1"] == "unified"
+
+
+def test_scale_down_candidate_protects_roles():
+    table = _table_with_roles()
+    # p0 is the least-loaded replica — the naive victim
+    table.update_health("d0", ok=True, ready=True, body={
+        "role": "decode", "load": {"in_flight": 1, "queue_depth": 0}})
+    table.update_health("d1", ok=True, ready=True, body={
+        "role": "decode", "load": {"in_flight": 3, "queue_depth": 2}})
+    assert table.scale_down_candidate() == "p0"
+    assert table.scale_down_candidate(
+        exclude_roles=("prefill",)) == "d0"
+    assert table.scale_down_candidate(
+        exclude=("d0",), exclude_roles=("prefill",)) == "d1"
+    assert table.scale_down_candidate(
+        exclude=("d0", "d1"), exclude_roles=("prefill",)) is None
+
+
+def test_roleless_fleet_places_byte_for_byte():
+    """Satellite: a fleet that never advertises a role must place
+    exactly like one advertising ``unified`` everywhere — same chosen
+    replicas, same decision evidence, request for request."""
+    bare = ReplicaTable()
+    tagged = ReplicaTable()
+    for t in (bare, tagged):
+        t.add("r0", "http://r0")
+        t.add("r1", "http://r1")
+    tagged.update_health("r0", ok=True, ready=True,
+                         body={"role": "unified"})
+    tagged.update_health("r1", ok=True, ready=True,
+                         body={"role": "unified"})
+    blocks = bare.affinity_blocks("x" * 400)
+    bare.record_placement(bare._replicas["r1"], blocks)
+    tagged.record_placement(tagged._replicas["r1"], blocks)
+    for probe in (blocks, (), blocks[:1]):
+        (rep_a, dec_a) = bare.place_explained(probe)
+        (rep_b, dec_b) = tagged.place_explained(probe)
+        assert rep_a.name == rep_b.name
+        assert dec_a == dec_b
+    assert bare.prefill_candidate() is None
+    assert tagged.prefill_candidate() is None
+
+
+# ----------------------------------------------------------- pricing
+
+def test_handoff_beats_prefill_pricing():
+    # unmeasured transfer legs: the handoff is assumed to win (the
+    # first one IS the measurement), including the no-capacity case
+    assert handoff_beats_prefill(None, 8192)
+    assert handoff_beats_prefill({}, 8192)
+    # measured transfer but unmeasured prefill: nothing to beat
+    assert not handoff_beats_prefill(
+        {"d2h_ms_per_page": 0.5, "h2d_ms_per_page": 0.5}, 8192)
+    cap = {"page_size": 128, "d2h_ms_per_page": 0.5,
+           "h2d_ms_per_page": 0.5, "prefill_ms_per_token": 1.0}
+    # 8192 B ≈ 2048 tok = 16 pages: 16 ms transfer vs 2048 ms recompute
+    assert handoff_beats_prefill(cap, 8192)
+    # same prompt against a fast-prefill replica: recompute wins
+    assert not handoff_beats_prefill(
+        dict(cap, prefill_ms_per_token=0.001), 8192)
+
+
+def test_step_cost_handoff_cheaper():
+    model = StepCostModel(prefill_ms_per_token=0.125,
+                          h2d_ms_per_page=0.0, d2h_ms_per_page=0.0)
+    assert not model.handoff_cheaper(0, PAGE)       # nothing to ship
+    assert model.handoff_cheaper(4, PAGE)           # unmeasured: True
+    model = StepCostModel(prefill_ms_per_token=1.0,
+                          h2d_ms_per_page=0.5, d2h_ms_per_page=0.5)
+    assert model.handoff_cheaper(4, PAGE)           # 4 ms < 64 ms
+    model = StepCostModel(prefill_ms_per_token=0.01,
+                          h2d_ms_per_page=0.5, d2h_ms_per_page=0.5)
+    assert not model.handoff_cheaper(4, PAGE)       # 4 ms > 0.64 ms
+
+
+# ------------------------------------------------- donor export bound
+
+def test_kv_export_concurrency_bound_sheds_429(params, monkeypatch):
+    """Satellite: past KV_EXPORT_CONCURRENCY simultaneous exports the
+    donor answers a retryable 429 (kv_export_busy, Retry-After) and
+    counts kv_export_shed — it never queues a third device page-gather
+    behind live decode rounds."""
+    monkeypatch.setenv("KV_EXPORT_CONCURRENCY", "1")
+    eng = build_engine(params)
+    gate = asyncio.Event()
+
+    def slow_export(hashes):
+        import time
+        time.sleep(0.4)
+        return b"", 0
+
+    async def fn():
+        eng.export_blob = slow_export
+        app = replica_app(eng)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            hashes = "ab" * 16
+
+            async def first():
+                gate.set()
+                return await client.get(
+                    f"/control/kv_pages?hashes={hashes}")
+
+            t1 = asyncio.ensure_future(first())
+            await gate.wait()
+            await asyncio.sleep(0.05)   # let t1 occupy the export slot
+            resp = await client.get(f"/control/kv_pages?hashes={hashes}")
+            assert resp.status == 429
+            body = await resp.json()
+            assert body["error"]["type"] == "kv_export_busy"
+            assert "Retry-After" in resp.headers
+            assert (await t1).status == 200
+            # the slot freed: the retry the 429 asked for now succeeds
+            resp = await client.get(f"/control/kv_pages?hashes={hashes}")
+            assert resp.status == 200
+        finally:
+            await client.close()
+
+    with eng:
+        _run(fn())
+    assert eng.stats["kv_export_shed"] == 1
+
+
+# ------------------------------------- the acceptance pin: full handoff
+
+def test_disagg_handoff_token_identical_over_real_http(params,
+                                                       monkeypatch):
+    """1 prefill + 1 decode replica behind the real router: a long
+    prompt is served through the two-leg handoff — prefill leg on p0,
+    KV pages pushed over a real HTTP ``/control/kv_resume`` leg,
+    decode admission on d0 as a near-full prefix hit — and the bytes
+    out are IDENTICAL to the same request on a unified replica. Short
+    requests never touch the prefill replica."""
+    from generativeaiexamples_tpu.router.server import create_router_app
+
+    monkeypatch.setenv("ROUTER_DISAGG_MIN_PROMPT_BYTES", "400")
+    prefill_eng = build_engine(params, role="prefill")
+    decode_eng = build_engine(params, role="decode")
+    unified_eng = build_engine(params, role="unified")
+    body = long_body("parity")
+
+    async def fn():
+        ref_server = TestServer(replica_app(unified_eng))
+        p_server = TestServer(replica_app(prefill_eng))
+        d_server = TestServer(replica_app(decode_eng))
+        for s in (ref_server, p_server, d_server):
+            await s.start_server()
+        router_app = create_router_app(
+            [("p0", f"http://127.0.0.1:{p_server.port}"),
+             ("d0", f"http://127.0.0.1:{d_server.port}")],
+            policy="affinity", heartbeat_s=30, kv_transfer=True,
+            run_heartbeat=False)
+        client = TestClient(TestServer(router_app))
+        await client.start_server()
+        ref_client = TestClient(ref_server)
+        try:
+            # the UNIFIED reference answer, same body, no router
+            resp = await ref_client.post("/generate", json=body)
+            assert resp.status == 200
+            reference = (await resp.read()).decode()
+            assert reference and "[error]" not in reference
+
+            # one heartbeat sweep teaches the router the roles
+            await client.post("/control/heartbeat")
+            snap = await (await client.get("/router/replicas")).json()
+            roles = {r["name"]: r["role"] for r in snap["replicas"]}
+            assert roles == {"p0": "prefill", "d0": "decode"}
+            fleet = await (await client.get("/debug/fleet")).json()
+            assert fleet["fleet"]["roles"] == {"prefill": 1, "decode": 1}
+
+            handoffs0 = _snap("router_disagg_handoffs_total")
+            resp = await client.post("/generate", json=body,
+                                     headers={"X-Request-ID": "dis-1"})
+            assert resp.status == 200
+            # the decode replica served it; the prefill replica is
+            # reached only through the handoff leg
+            assert resp.headers["X-Routed-Replica"] == "d0"
+            answer = (await resp.read()).decode()
+            assert answer == reference
+            assert _snap("router_disagg_handoffs_total") == handoffs0 + 1
+
+            # the handoff was REAL: pages were exported on p0, pushed
+            # over HTTP into d0's host tier, and restored at admission
+            assert prefill_eng.stats["kv_tier_export_pages"] > 0
+            assert prefill_eng.stats["prefills"] >= 1
+            assert decode_eng.stats["kv_tier_resumed_blocks"] > 0
+            assert decode_eng.stats["kv_tier_restore_pages"] >= 1
+
+            # both legs share one timeline under the caller's rid
+            dbg = await (await client.get(
+                "/debug/requests?limit=10")).json()
+            tl = next(t for t in dbg["completed"]
+                      if t["request_id"] == "dis-1")
+            names = [e["event"] for e in tl["events"]]
+            assert "router_disagg_prefill" in names
+            assert "disagg_handoff" in names
+
+            # SHORT decode-bound request: under the byte floor, no
+            # handoff, and the prefill replica never sees it
+            prefills_before = prefill_eng.stats["prefills"]
+            resp = await client.post("/generate", json={
+                "question": "short one?", "use_knowledge_base": False,
+                "num_tokens": 4})
+            assert resp.status == 200
+            assert resp.headers["X-Routed-Replica"] == "d0"
+            assert "[error]" not in (await resp.read()).decode()
+            assert _snap("router_disagg_handoffs_total") == handoffs0 + 1
+            assert prefill_eng.stats["prefills"] == prefills_before
+        finally:
+            await client.close()
+            await ref_client.close()
+            for s in (p_server, d_server):
+                await s.close()
+
+    with prefill_eng, decode_eng, unified_eng:
+        _run(fn())
+
+
+def test_roleless_fleet_never_enters_disagg_path(params, monkeypatch):
+    """The enable gate is the fleet: with no prefill-role replica the
+    same long prompt takes the plain placement path — no handoff, no
+    fallback, no prefill-leg stage on the timeline."""
+    from generativeaiexamples_tpu.router.server import create_router_app
+
+    monkeypatch.setenv("ROUTER_DISAGG_MIN_PROMPT_BYTES", "400")
+    eng = build_engine(params)
+    body = long_body("roleless")
+
+    async def fn():
+        server = TestServer(replica_app(eng))
+        await server.start_server()
+        router_app = create_router_app(
+            [("r0", f"http://127.0.0.1:{server.port}")],
+            policy="affinity", heartbeat_s=30, run_heartbeat=False)
+        client = TestClient(TestServer(router_app))
+        await client.start_server()
+        try:
+            await client.post("/control/heartbeat")
+            h0 = _snap("router_disagg_handoffs_total")
+            f0 = sum(_snap(
+                f'router_disagg_fallbacks_total{{reason="{r}"}}')
+                for r in ("prefill_error", "prefill_timeout",
+                          "no_pages"))
+            resp = await client.post("/generate", json=body,
+                                     headers={"X-Request-ID": "nr-1"})
+            assert resp.status == 200
+            assert "[error]" not in (await resp.read()).decode()
+            assert _snap("router_disagg_handoffs_total") == h0
+            assert sum(_snap(
+                f'router_disagg_fallbacks_total{{reason="{r}"}}')
+                for r in ("prefill_error", "prefill_timeout",
+                          "no_pages")) == f0
+            dbg = await (await client.get(
+                "/debug/requests?limit=10")).json()
+            tl = next(t for t in dbg["completed"]
+                      if t["request_id"] == "nr-1")
+            assert "router_disagg_prefill" \
+                not in [e["event"] for e in tl["events"]]
+        finally:
+            await client.close()
+            await server.close()
+
+    with eng:
+        _run(fn())

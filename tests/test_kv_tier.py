@@ -292,6 +292,52 @@ def test_chaos_transfer_hang_places_cold(params):
     assert eng.stats["kv_tier_transfer_pages"] == 0
 
 
+def test_export_handoff_keeps_pages_resident(params):
+    """Disaggregation donor side (docs/disaggregation.md): unlike
+    suspend, export_handoff leaves the pages RESIDENT — the donor keeps
+    serving pull-side /control/kv_pages fallbacks for the same prefix —
+    and the blob round-trips the full chain."""
+    eng = _build(params, host_tokens=4096)
+    target = _prompt(12, 3 * PAGE)
+    with eng:
+        cold = eng.submit(target, SP)
+        cold.text()
+        cached_before = eng._prefix_cache.cached_pages
+        out = eng.export_handoff(target)
+        assert out is not None
+        blob, n = out
+        assert n == 3
+        # pages stayed put — nothing was demoted or dropped
+        assert eng._prefix_cache.cached_pages == cached_before
+        meta, recs = from_blob(blob)
+        assert [r.hash for r in recs] == hash_blocks(target, PAGE)
+        assert meta["page_size"] == PAGE
+        # a chain this engine never served exports nothing
+        assert eng.export_handoff(_prompt(99, 2 * PAGE)) is None
+    assert eng.stats["kv_tier_export_pages"] == 3
+    untiered = _build(params, host_tokens=0)
+    from generativeaiexamples_tpu.utils.errors import EngineError
+    with pytest.raises(EngineError, match="disabled"):
+        untiered.export_handoff(target)
+
+
+def test_push_blob_hang_and_dead_target_bounded():
+    """The handoff push (donor → decode /control/kv_resume) must be
+    bounded like the pull: a hung transfer or a dead receiver answers
+    False within timeout_s — the donor then reports pushed=false and
+    the router falls back to recompute."""
+    faults.set_plan("kv.transfer=hang")
+    t0 = time.monotonic()
+    assert kv_tier.push_blob("http://127.0.0.1:1", b"x",
+                             timeout_s=0.4) is False
+    assert time.monotonic() - t0 < 3.0
+    assert faults.fired("kv.transfer") >= 1
+    faults.clear()
+    # connect-refused receiver: also False, no raise
+    assert kv_tier.push_blob("http://127.0.0.1:1", b"x",
+                             timeout_s=0.5) is False
+
+
 def test_suspend_resume_round_trip_across_engines(params):
     """Suspend on engine A, resume on engine B (same geometry): B's
     next turn restores without recompute, token-identical."""

@@ -126,6 +126,33 @@ def test_extract_multichip_rung_metrics_direction_aware():
     assert any("multichip.ttft_p50_ms@tp=2" in r for r in regressions)
 
 
+def test_extract_disagg_arm_metrics_direction_aware():
+    """Disagg arms contribute per-arm gates (docs/disaggregation.md):
+    the scenario's claim is the disagg arm wins BOTH p50 TTFT (down)
+    and decode goodput (up), so each is gated round-over-round — a
+    handoff path that quietly stopped protecting decode rounds
+    regresses the gate even when the unified arm held."""
+    result = _result(disagg={"arms": [
+        {"arm": "unified", "ttft_p50_ms": 120.0,
+         "decode_goodput": 60.0},
+        {"arm": "disagg", "ttft_p50_ms": 80.0,
+         "decode_goodput": 90.0},
+    ]})
+    m = extract_metrics(result)
+    assert m["disagg.ttft_p50_ms@disagg"] == (80.0, "lower")
+    assert m["disagg.decode_goodput@disagg"] == (90.0, "higher")
+    assert m["disagg.ttft_p50_ms@unified"] == (120.0, "lower")
+    assert m["disagg.decode_goodput@unified"] == (60.0, "higher")
+    # the disagg arm regressing toward unified trips BOTH gates
+    worse = extract_metrics(_result(disagg={"arms": [
+        {"arm": "disagg", "ttft_p50_ms": 115.0,
+         "decode_goodput": 62.0},
+    ]}))
+    regressions, _ = compare(m, worse)
+    assert any("disagg.ttft_p50_ms@disagg" in r for r in regressions)
+    assert any("disagg.decode_goodput@disagg" in r for r in regressions)
+
+
 def test_extract_tolerates_missing_sections():
     m = extract_metrics({"decode_tokens_per_sec": 100.0, "chat": {}})
     assert set(m) == {"decode_tokens_per_sec"}

@@ -44,6 +44,47 @@ def test_perf_gate_detects_regression(tmp_path):
     assert errors and "missing" in errors[0]
 
 
+def test_disagg_check_detects_failure_classes():
+    """The disagg check is green on the synthetic section and actually
+    fails on each class of broken artifact — a disagg gate that can't
+    fail would let the scenario silently measure unified twice."""
+    import copy
+
+    assert preflight.validate_disagg_block(
+        preflight.synthetic_disagg()) == []
+    # disagg arm without a prefill/decode split
+    block = preflight.synthetic_disagg()
+    block["arms"][1]["roles"] = {"decode": 2}
+    assert any("prefill/decode" in e
+               for e in preflight.validate_disagg_block(block))
+    # unified arm that is secretly role-split
+    block = preflight.synthetic_disagg()
+    block["arms"][0]["roles"] = {"prefill": 1, "decode": 1}
+    assert any("all-unified" in e
+               for e in preflight.validate_disagg_block(block))
+    # roles not summing to the chip count breaks equal-chips
+    block = preflight.synthetic_disagg()
+    block["arms"][1]["roles"] = {"prefill": 1, "decode": 2}
+    assert any("equal-chips" in e
+               for e in preflight.validate_disagg_block(block))
+    # zero handoffs AND zero fallbacks: the two-leg path never ran
+    block = preflight.synthetic_disagg()
+    block["arms"][1]["handoffs"] = 0
+    block["arms"][1]["fallbacks"] = 0
+    assert any("measured" in e and "twice" in e
+               for e in preflight.validate_disagg_block(block))
+    # a missing arm kills the comparison outright
+    block = preflight.synthetic_disagg()
+    block["arms"] = [block["arms"][0]]
+    assert any("missing the 'disagg' arm" in e
+               for e in preflight.validate_disagg_block(block))
+    # schema drift (field rename) is caught by the element-wise pass
+    block = copy.deepcopy(preflight.synthetic_disagg())
+    block["arms"][1]["goodput"] = block["arms"][1].pop("decode_goodput")
+    assert any("disagg.arms[1]" in e
+               for e in preflight.validate_disagg_block(block))
+
+
 def test_metrics_docs_check_is_the_real_one(monkeypatch):
     """preflight's metrics-docs check is the same two-way checker the
     dedicated tier-1 test runs — doctor the doc text and it must

@@ -77,7 +77,7 @@ def validate_result(result: dict, schema: dict | None = None) -> None:
     _check_types("result", result, schema["top_level"], errors)
     for section in ("engine_pipeline", "engine_rounds", "e2e_ttft_dist_ms",
                     "chat", "openloop", "fleet", "capacity", "multichip",
-                    "kv_pressure", "autoscale"):
+                    "kv_pressure", "autoscale", "disagg"):
         sub = result.get(section)
         if isinstance(sub, dict):
             _check_types(section, sub, schema[section], errors)
@@ -201,6 +201,21 @@ def validate_result(result: dict, schema: dict | None = None) -> None:
                     errors.append(
                         f"autoscale.policies[{i}]: {entry!r} is not an "
                         f"object")
+    # Disaggregation scenario: each arm (unified / disagg at equal
+    # chips) carries the TTFT + decode-goodput headline fields and the
+    # handoff accounting — validated element-wise so a rename in one
+    # arm's dict can't hide behind the list type.
+    disagg = result.get("disagg")
+    if isinstance(disagg, dict):
+        arms = disagg.get("arms")
+        if isinstance(arms, list):
+            for i, entry in enumerate(arms):
+                if isinstance(entry, dict):
+                    _check_types(f"disagg.arms[{i}]", entry,
+                                 schema["disagg_arm"], errors)
+                else:
+                    errors.append(
+                        f"disagg.arms[{i}]: {entry!r} is not an object")
     breakdown = result.get("e2e_breakdown_ms")
     if isinstance(breakdown, dict):
         allowed = set(schema["breakdown_stages"])

@@ -51,6 +51,15 @@ metric                                  direction  source
                                                    mesh rung — TTFT must
                                                    DROP as chips grow,
                                                    not merely hold
+``disagg.ttft_p50_ms@<arm>``            lower      disagg scenario, per
+                                                   arm (unified /
+                                                   disagg at equal
+                                                   chips)
+``disagg.decode_goodput@<arm>``         higher     disagg scenario, per
+                                                   arm — the handoff
+                                                   must protect decode
+                                                   rounds, not just
+                                                   TTFT
 ======================================  =========  =====================
 
 Accepts raw bench results or the driver's artifact wrapper (an object
@@ -98,6 +107,11 @@ _AUTOSCALE_DIRECTIONS = {"slo_attainment": "higher",
 _MULTICHIP_FIELDS = {"decode_tokens_per_sec": ("tokens_per_sec",
                                                "higher"),
                      "engine_p50_ttft_ms": ("ttft_p50_ms", "lower")}
+#: Disaggregation-scenario headlines, per arm (unified / disagg at
+#: equal chips): the PR's claim is the disagg arm wins BOTH — p50 TTFT
+#: down AND decode goodput up — so both are gated round-over-round.
+_DISAGG_DIRECTIONS = {"ttft_p50_ms": "lower",
+                      "decode_goodput": "higher"}
 
 DEFAULT_THRESHOLD_PCT = 5.0
 
@@ -181,6 +195,18 @@ def extract_metrics(result: dict) -> dict[str, tuple[float, str]]:
                 v = _num(entry.get(field))
                 if v is not None:
                     out[f"multichip.{name}@{mesh}"] = (v, direction)
+    disagg = result.get("disagg")
+    if isinstance(disagg, dict):
+        for entry in disagg.get("arms") or []:
+            if not isinstance(entry, dict):
+                continue
+            arm = entry.get("arm")
+            if not arm:
+                continue
+            for key, direction in _DISAGG_DIRECTIONS.items():
+                v = _num(entry.get(key))
+                if v is not None:
+                    out[f"disagg.{key}@{arm}"] = (v, direction)
     return out
 
 

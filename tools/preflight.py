@@ -42,6 +42,12 @@ Checks:
   topology-derived round budget, mesh rungs serve the ``fused_tp``
   tail — a ``materialized`` mesh rung is the silent regression this
   PR's tentpole removed).
+- **disagg** — the ``BENCH_DISAGG`` scenario's ``disagg`` section
+  contract (docs/disaggregation.md): schema element-wise plus the
+  semantic invariants (both arms present at EQUAL chip counts, the
+  disagg arm's role census actually splits prefill/decode, and its
+  handoff accounting shows the two-leg path ran — a disagg arm with
+  zero handoffs AND zero fallbacks silently degenerated to unified).
 - **perf-gates** — ``tools/perf_diff.py`` over committed artifact
   pairs: each later round must not regress the earlier one's headline
   metrics (the same pairs/thresholds the tier-1 perf_diff test pins).
@@ -146,7 +152,8 @@ def check_bench_schema() -> list[str]:
         prompt_len=16, out_len=4, slots=2, steps_per_round=4,
         kv_pool_pages=8, device="cpu", rtt_ms=None, n_devices=1,
         bench_seconds=1.0, fleet=fleet, kv_pressure=kv_pressure,
-        autoscale=autoscale, multichip=synthetic_multichip())
+        autoscale=autoscale, multichip=synthetic_multichip(),
+        disagg=synthetic_disagg())
     try:
         validate_result(result)
     except BenchSchemaError as exc:
@@ -232,6 +239,92 @@ def validate_multichip_block(block: dict) -> list[str]:
                 f"{rung.get('tail')!r} — the tp-sharded fused sampler "
                 f"regressed to a fallback")
     return errors
+
+
+def synthetic_disagg() -> dict:
+    """A fully-populated ``disagg`` bench section (the BENCH_DISAGG
+    scenario's output shape) — shared by the bench-schema synthetic
+    result and the disagg check below; returned fresh so the tier-1
+    test can doctor a copy to prove the check fails."""
+    return {
+        "replicas": 2, "requests": 24, "rps": 4.0, "long_frac": 0.4,
+        "long_chars": 4600, "short_chars": 400, "num_tokens": 16,
+        "arms": [
+            {"arm": "unified", "roles": {"unified": 2},
+             "offered": 24, "completed": 24, "errors": 0,
+             "ttft_p50_ms": 120.0, "ttft_p99_ms": 400.0,
+             "long_ttft_p50_ms": 300.0, "short_ttft_p50_ms": 90.0,
+             "tokens_generated": 384, "decode_goodput": 60.0,
+             "handoffs": 0, "fallbacks": 0, "kv_export_pages": 0,
+             "kv_export_shed": 0, "kv_transfer_pages": 0},
+            {"arm": "disagg", "roles": {"prefill": 1, "decode": 1},
+             "offered": 24, "completed": 24, "errors": 0,
+             "ttft_p50_ms": 80.0, "ttft_p99_ms": 280.0,
+             "long_ttft_p50_ms": 200.0, "short_ttft_p50_ms": 60.0,
+             "tokens_generated": 384, "decode_goodput": 90.0,
+             "handoffs": 9, "fallbacks": 1, "kv_export_pages": 36,
+             "kv_export_shed": 0, "kv_transfer_pages": 4},
+        ],
+    }
+
+
+def validate_disagg_block(block: dict) -> list[str]:
+    """Element-wise + semantic validation of one ``disagg`` section:
+    schema per arm, both arms present at EQUAL chip counts, the disagg
+    arm's role census genuinely split (>= 1 prefill AND >= 1 decode,
+    summing to ``replicas``), and its handoff accounting non-degenerate
+    (a disagg arm with zero handoffs and zero fallbacks means the
+    router never conducted the two-leg path — the arm silently measured
+    unified twice)."""
+    sys.path.insert(0, REPO)
+    from tools.check_bench_schema import (BenchSchemaError, load_schema,
+                                          validate_result)
+    errors: list[str] = []
+    try:
+        validate_result({"disagg": block},
+                        schema={**load_schema(),
+                                "top_level": {"disagg": ["obj"]}})
+    except BenchSchemaError as exc:
+        errors.append(str(exc))
+    arms = {a.get("arm"): a for a in (block.get("arms") or [])
+            if isinstance(a, dict)}
+    for want in ("unified", "disagg"):
+        if want not in arms:
+            errors.append(f"arms: missing the {want!r} arm — the "
+                          f"comparison needs both at equal chips")
+    if len(arms) < 2:
+        return errors
+    replicas = block.get("replicas")
+    for name, arm in arms.items():
+        roles = arm.get("roles") or {}
+        if sum(roles.values()) != replicas:
+            errors.append(
+                f"arms[{name}]: roles {roles} do not sum to replicas="
+                f"{replicas} — the equal-chips comparison is broken")
+    droles = arms["disagg"].get("roles") or {}
+    if not (droles.get("prefill", 0) >= 1 and droles.get("decode", 0) >= 1):
+        errors.append(
+            f"arms[disagg]: role census {droles} is not a prefill/decode "
+            f"split")
+    if set((arms["unified"].get("roles") or {})) != {"unified"}:
+        errors.append(
+            f"arms[unified]: role census "
+            f"{arms['unified'].get('roles')} is not all-unified")
+    if not (arms["disagg"].get("handoffs", 0)
+            or arms["disagg"].get("fallbacks", 0)):
+        errors.append(
+            "arms[disagg]: zero handoffs AND zero fallbacks — the "
+            "router never conducted the two-leg path; the arm measured "
+            "unified twice")
+    return errors
+
+
+def check_disagg() -> list[str]:
+    """Validate the disagg scenario contract over the synthetic section
+    (schema + equal-chips/role-split/handoff invariants) — the same
+    validator bench consumers can run over a real BENCH_DISAGG
+    artifact."""
+    return validate_disagg_block(synthetic_disagg())
 
 
 def check_multichip() -> list[str]:
@@ -447,6 +540,7 @@ CHECKS: dict[str, Callable[[], list[str]]] = {
     "fleet-obs": check_fleet_obs,
     "autoscale": check_autoscale,
     "multichip": check_multichip,
+    "disagg": check_disagg,
     "perf-gates": check_perf_gates,
 }
 
