@@ -776,7 +776,8 @@ def run_multichip_sweep(params, model_cfg, tokenizer, rungs, *,
 def build_fleet_engines(params, model_cfg, tokenizer, n: int,
                         host_pool_tokens: int = 0,
                         roles: Sequence[str] = (),
-                        max_input_length: int = 2048):
+                        max_input_length: int = 2048,
+                        steps_per_round: int | None = None):
     """N small replica engines over SHARED params (read-only on device —
     weights are never duplicated) with explicit, modest KV pools
     (``BENCH_FLEET_KV_POOL_TOKENS``, default 4096 tokens each): the main
@@ -798,7 +799,8 @@ def build_fleet_engines(params, model_cfg, tokenizer, n: int,
         prefill_buckets=(512, 1024), dtype="bfloat16",
         kv_pool_tokens=pool,
         kv_quant=os.environ.get("BENCH_KV_QUANT", ""),
-        steps_per_round=int(os.environ.get("BENCH_STEPS_PER_ROUND", "16")),
+        steps_per_round=(int(os.environ.get("BENCH_STEPS_PER_ROUND", "16"))
+                         if steps_per_round is None else steps_per_round),
         dispatch_depth=int(os.environ.get("BENCH_DISPATCH_DEPTH", "2")),
         kv_host_pool_tokens=max(0, int(host_pool_tokens)))
     # Mask the env overrides for the build: KV_HOST_POOL_TOKENS /
@@ -1302,6 +1304,365 @@ def run_disagg_bench(params, model_cfg, tokenizer, *,
     }
 
 
+def run_failover_bench(params, model_cfg, tokenizer, *,
+                       replicas=3, requests=16, rps=3.0,
+                       num_tokens=32, seed=0, heartbeat_s=0.3,
+                       max_input_length=2048):
+    """Mid-stream replica loss under open-loop load, transcript-replay
+    resume on vs off (docs/robustness.md): two arms over the SAME
+    traffic shape and the SAME scripted kill.
+
+    Each arm serves ``replicas`` unified replicas behind the router,
+    every replica on its own killable server. Mid-run a designated
+    victim request starts streaming, its routed replica is read off
+    ``X-Routed-Replica``, and that server is torn down with the victim
+    (plus any open-loop streams it was serving) mid-stream.
+
+    - ``resume_on``: router resume budget 1 — the router re-places the
+      severed streams on a sibling and replays the transcript; the
+      headline ``completed_no_error_rate`` should hold at 1.0.
+    - ``resume_off``: budget 0 — every severed stream gets the classic
+      in-band error frame; the same rate quantifies the client-visible
+      blast radius resume removes.
+
+    Per arm: completed/error accounting, resume outcome counters
+    (``router_resume_total`` deltas), and the latency the resumed
+    streams paid over their unresumed peers (p50 duration delta from
+    the router's flight recorder). Gated round-over-round by
+    ``tools/perf_diff.py`` (``failover.*@<arm>``)."""
+    import statistics
+
+    import numpy as _np
+    import requests as _rq
+    from aiohttp import web
+
+    from generativeaiexamples_tpu.chains.examples.developer_rag import (
+        QAChatbot)
+    from generativeaiexamples_tpu.chains.llm import EngineLLM
+    from generativeaiexamples_tpu.chains.server import create_app
+    from generativeaiexamples_tpu.embed.encoder import HashEmbedder
+    from generativeaiexamples_tpu.obs import metrics as obs_metrics
+    from generativeaiexamples_tpu.router.server import create_router_app
+    from generativeaiexamples_tpu.utils import faults
+    from generativeaiexamples_tpu.utils.app_config import AppConfig
+    from generativeaiexamples_tpu.utils.configuration import from_dict
+
+    cfg = from_dict(AppConfig, {
+        "llm": {"model_engine": "tpu-jax"},
+        "embeddings": {"model_engine": "hash", "dimensions": 32},
+    })
+    pool = int(os.environ.get("BENCH_FLEET_KV_POOL_TOKENS", "4096"))
+
+    def words(tag: str, n_chars: int) -> str:
+        import hashlib
+        h = int.from_bytes(hashlib.blake2b(
+            tag.encode(), digest_size=4).digest(), "little")
+        rng = _np.random.RandomState(h)
+        toks = []
+        total = 0
+        while total < n_chars:
+            w = "".join(chr(97 + c) for c in rng.randint(0, 26, size=5))
+            toks.append(w)
+            total += 6
+        return " ".join(toks)[:n_chars]
+
+    def serve_one(app):
+        """One replica on its OWN loop + thread so it can be torn down
+        mid-arm without taking the rest of the fleet with it (the shared
+        ``serve_apps`` helper only offers a global stop)."""
+        loop = asyncio.new_event_loop()
+        box: dict = {}
+        started = threading.Event()
+
+        def run():
+            asyncio.set_event_loop(loop)
+
+            async def boot():
+                runner = web.AppRunner(app)
+                await runner.setup()
+                # shutdown_timeout on the SITE: cleanup() grants
+                # in-flight handlers 0.2 s, then force-closes their
+                # connections — the wire shape of a pod dying.
+                site = web.TCPSite(runner, "127.0.0.1", 0,
+                                   shutdown_timeout=0.2)
+                await site.start()
+                box["port"] = runner.addresses[0][1]
+                box["runner"] = runner
+            loop.run_until_complete(boot())
+            started.set()
+            loop.run_forever()
+
+        threading.Thread(target=run, daemon=True).start()
+        if not started.wait(60):
+            raise RuntimeError("failover replica server failed to boot")
+        done = threading.Event()
+
+        def kill():
+            if done.is_set():
+                return
+            done.set()
+            fut = asyncio.run_coroutine_threadsafe(
+                box["runner"].cleanup(), loop)
+            try:
+                fut.result(timeout=30)
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+            finally:
+                loop.call_soon_threadsafe(loop.stop)
+
+        return f"http://127.0.0.1:{box['port']}", kill
+
+    rng = _np.random.RandomState(seed)
+    delays = _np.cumsum(rng.exponential(1.0 / rps, size=requests))
+
+    _RESUME_FAIL = ("no_replica", "rejected", "connect_fail",
+                    "overflow", "budget_exhausted")
+
+    # Small decode rounds (4 tokens each, vs the throughput-oriented
+    # 16): the scripted kill lands DURING decode only if decode spans
+    # several rounds — a 16-step round drains a whole short completion
+    # in ~2 dispatches, finishing the upstream stream before the killed
+    # server's shutdown grace (0.2 s + 0.2 s cancel) expires, and the
+    # teardown then has nothing to sever. The fleet is shared by both
+    # arms: the scripted kill tears down a replica's HTTP SERVER, not
+    # its engine, so the second arm re-serves the same engines behind
+    # fresh servers (and skips a second round of pool allocation +
+    # compile warm-up).
+    fleet = build_fleet_engines(
+        params, model_cfg, tokenizer, replicas,
+        host_pool_tokens=pool * 4,
+        max_input_length=max_input_length,
+        steps_per_round=4)
+    for eng in fleet:
+        eng.start()
+
+    def one_arm(label: str, resume_attempts: int) -> dict:
+        engines = fleet
+        kills: list = []
+        try:
+            apps = [create_app(QAChatbot(llm=EngineLLM(eng),
+                                         embedder=HashEmbedder(dim=32),
+                                         config=cfg, fused_rag=False),
+                               config=cfg)
+                    for eng in engines]
+            served = [serve_one(app) for app in apps]
+            replica_urls = [u for u, _ in served]
+            kills = [k for _, k in served]
+            router_app = create_router_app(
+                [(f"r{i}", u) for i, u in enumerate(replica_urls)],
+                policy="affinity", heartbeat_s=heartbeat_s,
+                resume_attempts=resume_attempts, run_heartbeat=True)
+            (router_url,), stop_router = serve_apps([router_app])
+            _rq.post(f"{router_url}/control/heartbeat", timeout=30)
+            # Warm every replica (compile prefill/decode) so the
+            # scripted kill lands on a stream that is actually
+            # emitting tokens, not one stuck behind compilation.
+            for i, u in enumerate(replica_urls):
+                _rq.post(f"{u}/generate",
+                         json={"question": words(f"fw-{label}-{i}", 40),
+                               "context": words(f"fwc-{label}-{i}", 200),
+                               "use_knowledge_base": False,
+                               "num_tokens": 4}, timeout=300)
+            snap0 = obs_metrics.REGISTRY.snapshot()
+            before = [dict(e.stats) for e in engines]
+            results: list[dict] = []
+            res_lock = threading.Lock()
+            first_byte = [threading.Event() for _ in range(requests)]
+
+            def run_request(i: int, start_delay: float):
+                time.sleep(max(0.0, start_delay))
+                tag = f"failover-{label}-{seed}-{i}"
+                t0 = time.monotonic()
+                row = {"i": i, "ok": False, "error_frame": False,
+                       "ttft_ms": None}
+                try:
+                    with _rq.post(
+                            f"{router_url}/generate",
+                            json={"question": words(f"{tag}-q", 40),
+                                  "context": words(tag, 200),
+                                  "use_knowledge_base": False,
+                                  "num_tokens": num_tokens},
+                            stream=True, timeout=300) as resp:
+                        if resp.status_code == 200:
+                            it = resp.iter_content(chunk_size=1)
+                            body = b""
+                            for b in it:
+                                body = b
+                                row["ttft_ms"] = \
+                                    (time.monotonic() - t0) * 1e3
+                                first_byte[i].set()
+                                break
+                            for b in it:
+                                body += b
+                            answer = body.decode("utf-8",
+                                                 errors="replace")
+                            row["error_frame"] = "[error]" in answer
+                            row["ok"] = not row["error_frame"]
+                        else:
+                            row["status"] = resp.status_code
+                except _rq.RequestException as exc:
+                    row["error"] = str(exc)
+                finally:
+                    first_byte[i].set()
+                with res_lock:
+                    results.append(row)
+
+            t_traffic = time.monotonic()
+            threads = [threading.Thread(target=run_request,
+                                        args=(i, delays[i]), daemon=True)
+                       for i in range(requests)]
+            for th in threads:
+                th.start()
+            # The scripted kill severs only streams PAST their first
+            # byte (a loss in the pre-first-byte phase is a 502, not a
+            # resumable mid-stream loss, and would muddy the arm
+            # comparison), so wait for every open-loop stream's first
+            # byte before starting the victim.
+            for ev in first_byte:
+                ev.wait(timeout=300)
+
+            # The victim stream, from the main thread: its routed
+            # replica is severed right after its first byte, while it
+            # (and any open-loop neighbour still streaming there) is
+            # mid-stream. A dispatch-delay fault stretches each decode
+            # round past the killed server's shutdown grace for just
+            # this window (0.15 s/round x ~12 rounds of runway vs 0.4 s
+            # of grace), and is lifted right after the kill so the
+            # resume leg re-prefills at full speed.
+            killed_replica = None
+            vrow = {"i": -1, "ok": False, "error_frame": False,
+                    "ttft_ms": None, "victim": True}
+            vt0 = time.monotonic()
+            faults.set_plan("engine.dispatch=delay:0.15")
+            try:
+                with _rq.post(
+                        f"{router_url}/generate",
+                        json={"question": words(f"fv-{label}-q", 40),
+                              "context": words(f"fv-{label}", 200),
+                              "use_knowledge_base": False,
+                              "num_tokens": num_tokens},
+                        headers={"X-Request-ID": f"fv-{label}"},
+                        stream=True, timeout=300) as resp:
+                    if resp.status_code == 200:
+                        it = resp.iter_content(chunk_size=1)
+                        body = b""
+                        for b in it:
+                            body = b
+                            vrow["ttft_ms"] = \
+                                (time.monotonic() - vt0) * 1e3
+                            break
+                        killed_replica = resp.headers.get(
+                            "X-Routed-Replica")
+                        if killed_replica is not None:
+                            kills[int(killed_replica[1:])]()
+                        faults.clear()
+                        for b in it:
+                            body += b
+                        answer = body.decode("utf-8", errors="replace")
+                        vrow["error_frame"] = "[error]" in answer
+                        vrow["ok"] = not vrow["error_frame"]
+                    else:
+                        vrow["status"] = resp.status_code
+            except _rq.RequestException as exc:
+                vrow["error"] = str(exc)
+            finally:
+                faults.clear()
+            with res_lock:
+                results.append(vrow)
+
+            for th in threads:
+                th.join(timeout=600)
+            # Resumed-vs-unresumed durations from the router's flight
+            # recorder (completed ring), read before teardown.
+            resumed_ms: list[float] = []
+            plain_ms: list[float] = []
+            try:
+                debug = _rq.get(f"{router_url}/debug/requests",
+                                timeout=30).json()
+                for tl_row in debug.get("completed", []):
+                    meta = tl_row.get("meta", {})
+                    dur = meta.get("duration_ms")
+                    if meta.get("outcome") != "ok" or dur is None:
+                        continue
+                    if meta.get("resumed"):
+                        resumed_ms.append(float(dur))
+                    else:
+                        plain_ms.append(float(dur))
+            except (_rq.RequestException, ValueError):
+                pass
+            stop_router()
+            snap1 = obs_metrics.REGISTRY.snapshot()
+            after = [dict(e.stats) for e in engines]
+        finally:
+            for kill in kills:
+                try:
+                    kill()
+                except Exception:  # noqa: BLE001
+                    pass
+
+        def _delta(key: str) -> float:
+            return snap1.get(key, 0.0) - snap0.get(key, 0.0)
+
+        def _stat(key: str) -> int:
+            return int(sum(a.get(key, 0) - b.get(key, 0)
+                           for a, b in zip(after, before)))
+
+        ok_rows = [r for r in results if r["ok"]]
+        ttfts = sorted(r["ttft_ms"] for r in ok_rows
+                       if r["ttft_ms"] is not None)
+        offered = len(results)
+        resumed_p50 = (round(statistics.median(resumed_ms), 2)
+                       if resumed_ms else None)
+        plain_p50 = (round(statistics.median(plain_ms), 2)
+                     if plain_ms else None)
+        return {
+            "arm": label,
+            "resume_attempts": int(resume_attempts),
+            "offered": offered,
+            "completed": len(ok_rows),
+            "errors": offered - len(ok_rows),
+            "error_frames": sum(1 for r in results if r["error_frame"]),
+            "completed_no_error_rate": round(
+                len(ok_rows) / max(1, offered), 4),
+            "killed_replica": killed_replica,
+            "resumes_ok": int(_delta(
+                'router_resume_total{outcome="ok"}')),
+            "resumes_failed": int(sum(_delta(
+                f'router_resume_total{{outcome="{o}"}}')
+                for o in _RESUME_FAIL)),
+            "resume_replay_tokens": int(_delta(
+                "router_resume_replay_tokens")),
+            "resumed_p50_ms": resumed_p50,
+            "unresumed_p50_ms": plain_p50,
+            "resumed_added_p50_ms": (
+                round(max(0.0, resumed_p50 - plain_p50), 2)
+                if resumed_p50 is not None and plain_p50 is not None
+                else None),
+            "ttft_p50_ms": (round(statistics.median(ttfts), 2)
+                            if ttfts else None),
+            "tokens_generated": _stat("tokens_generated"),
+        }
+
+    try:
+        arms = [
+            one_arm("resume_on", 1),
+            one_arm("resume_off", 0),
+        ]
+    finally:
+        for eng in fleet:
+            try:
+                eng.stop()
+            except Exception:  # noqa: BLE001
+                pass
+    return {
+        "replicas": int(replicas),
+        "requests": int(requests),
+        "rps": float(rps),
+        "num_tokens": int(num_tokens),
+        "arms": arms,
+    }
+
+
 def parse_trace(spec: str) -> list[tuple[float, float]]:
     """``frac:rps,frac:rps,...`` — the diurnal arrival trace shape
     (fractions of the run's duration; they need not sum to 1, they are
@@ -1763,7 +2124,7 @@ def assemble_result(*, kind, model, headline, engine_p50, engine_p99, tput,
                     bench_seconds, e2e_tps_p50=None, openloop=None,
                     fleet=None, capacity=None, rounds=None,
                     kv_pressure=None, autoscale=None,
-                    multichip=None, disagg=None) -> dict:
+                    multichip=None, disagg=None, failover=None) -> dict:
     """The bench's single output contract. Every field name here is
     pinned by tools/bench_schema.json (validated at emit time AND by the
     tier-1 suite, tests/test_bench_schema.py) so a rename fails fast
@@ -1834,6 +2195,12 @@ def assemble_result(*, kind, model, headline, engine_p50, engine_p99, tput,
         # long/short prompt mix — TTFT p50 + decode goodput per arm
         # (docs/disaggregation.md). Null when not requested.
         "disagg": disagg,
+        # Failover scenario (BENCH_FAILOVER=1): scripted mid-stream
+        # replica kill under open-loop load, transcript-replay resume
+        # on vs off — completed-without-client-visible-error rate and
+        # the latency resumed streams paid (docs/robustness.md). Null
+        # when not requested.
+        "failover": failover,
         "quantization": quant,
         "kv_quant": kv_quant,
         "weights": weights,
@@ -2376,6 +2743,27 @@ def main() -> None:
         except Exception as exc:  # noqa: BLE001
             sys.stderr.write(f"bench: disagg scenario failed: {exc}\n")
 
+    # Failover scenario (BENCH_FAILOVER=1): scripted mid-stream replica
+    # kill under open-loop load, resume-on vs resume-off arms
+    # (docs/robustness.md). Per-arm fleets are built and torn down
+    # inside the scenario (a killed replica server can't be reused).
+    # Degrades to null.
+    failover = None
+    if os.environ.get("BENCH_FAILOVER", "") not in ("", "0"):
+        try:
+            failover = run_failover_bench(
+                engine.params, model_cfg, engine.tokenizer,
+                replicas=int(os.environ.get(
+                    "BENCH_FAILOVER_REPLICAS", "3")),
+                requests=int(os.environ.get(
+                    "BENCH_FAILOVER_REQUESTS", "16")),
+                rps=float(os.environ.get("BENCH_FAILOVER_RPS", "3")),
+                num_tokens=int(os.environ.get(
+                    "BENCH_FAILOVER_TOKENS", "32")),
+                seed=int(os.environ.get("BENCH_SEED", "0")))
+        except Exception as exc:  # noqa: BLE001
+            sys.stderr.write(f"bench: failover scenario failed: {exc}\n")
+
     import jax
     # Headline = the full QA-chatbot path (BASELINE.json's north star is
     # the *chatbot* TTFT, not the engine-only number — VERDICT r3 weak
@@ -2391,6 +2779,7 @@ def main() -> None:
         pipeline=pipeline, openloop=openloop, fleet=fleet,
         capacity=capacity, rounds=rounds, kv_pressure=kv_pressure,
         autoscale=autoscale, multichip=multichip, disagg=disagg,
+        failover=failover,
         quant=quant, kv_quant=engine.cfg.kv_quant or None,
         weights=("real" if os.environ.get("BENCH_MODEL_PATH")
                  else "random-init"),

@@ -313,17 +313,21 @@ def create_app(example: BaseExample,
         return out
 
     async def health(request: web.Request) -> web.Response:
-        # Readiness is TRUTHFUL: draining or a tripped generate breaker
-        # means every /generate would be rejected, so k8s and the fleet
+        # Readiness is TRUTHFUL: draining, a tripped generate breaker,
+        # or a stalled engine (liveness watchdog — work queued but no
+        # round completing for ENGINE_WATCHDOG_STALL_S) means every
+        # /generate would be rejected or hang, so k8s and the fleet
         # router must both see not-ready (503) — the two placement
         # authorities can never disagree about this replica.
+        engine = getattr(getattr(example, "llm", None), "engine", None)
         if drain.draining:
             status, code = "draining", 503
         elif breaker.state == resilience.OPEN:
             status, code = "breaker_open", 503
+        elif getattr(engine, "stalled", False):
+            status, code = "engine_stalled", 503
         else:
             status, code = "ok", 200
-        engine = getattr(getattr(example, "llm", None), "engine", None)
         return web.json_response(
             {"status": status, "draining": drain.draining,
              "breaker": breaker.state,
@@ -433,11 +437,52 @@ def create_app(example: BaseExample,
         # tiering is off or no engine serves this chain.
         kv_donor = request.headers.get("X-KV-Transfer-From") or None
 
+        # Mid-stream failover continuation (docs/robustness.md): the
+        # router re-submits a request whose replica died on a 200 with
+        # a ``resume`` block carrying the generated-so-far TEXT. We
+        # tokenize it here and bind the ids into the request context;
+        # Engine.submit admits them as prompt + generated prefix (the
+        # prefix cache / host-tier restore / donor transfer make the
+        # replay cheap) and streams only what comes after.
+        resume_block = (body.get("resume")
+                        if isinstance(body.get("resume"), dict) else None)
+        resume_ids: Optional[list] = None
+        resume_attempt = 0
+
         # Drain gate FIRST: a draining replica admits nothing new (the
         # 429 tells the router/caller to go elsewhere) while the streams
-        # already in flight below run to completion.
-        if drain.draining:
+        # already in flight below run to completion. A resume is NOT new
+        # work — it is the continuation of a stream the fleet already
+        # accepted, so a draining sibling still takes it (the PR-7
+        # rollout contract keeps accepted streams running).
+        if drain.draining and resume_block is None:
             return _drain_reject(rid)
+
+        if resume_block is not None:
+            engine = getattr(getattr(example, "llm", None), "engine",
+                             None)
+            if engine is None or use_kb:
+                # No engine to replay into, or the fused-RAG admission
+                # path (retrieval re-runs replica-side and could
+                # diverge): refuse honestly — the router falls back to
+                # the classic error frame instead of a silent wrong
+                # continuation.
+                _shed("resume_unsupported")
+                return error_response(
+                    409, "resume_unsupported",
+                    "this replica cannot resume the stream ("
+                    + ("no engine" if engine is None
+                       else "retrieval-augmented request") + ")", rid)
+            resume_attempt = int(resume_block.get("attempt", 1) or 1)
+            text = str(resume_block.get("text", "") or "")
+            resume_ids = (engine.tokenizer.encode(text, add_bos=False)
+                          if text else [])
+            if len(resume_ids) >= num_tokens:
+                _shed("resume_exhausted")
+                return error_response(
+                    409, "resume_exhausted",
+                    f"resume replays {len(resume_ids)} tokens but the "
+                    f"request budget is {num_tokens}", rid)
 
         # Breaker fast-path: a generation path that keeps failing is
         # DOWN — reject in microseconds instead of queueing doomed work
@@ -513,6 +558,11 @@ def create_app(example: BaseExample,
                 # copied context as the timeline into Engine.submit.
                 from ..engine import kv_tier
                 kv_token = kv_tier.bind_transfer_source(kv_donor)
+            resume_token = None
+            if resume_ids is not None:
+                from ..engine import resume as engine_resume
+                resume_token = engine_resume.bind_resume(
+                    {"ids": resume_ids, "attempt": resume_attempt})
             timer = obs_metrics.RequestTimer("chain_generate")
             emitted = False
             drain.inc()
@@ -543,6 +593,9 @@ def create_app(example: BaseExample,
             finally:
                 drain.dec()
                 timer.finish()
+                if resume_token is not None:
+                    from ..engine import resume as engine_resume
+                    engine_resume.unbind_resume(resume_token)
                 if kv_token is not None:
                     from ..engine import kv_tier
                     kv_tier.unbind_transfer_source(kv_token)
@@ -615,6 +668,10 @@ def create_app(example: BaseExample,
             headers={"Content-Type": "text/event-stream",
                      "Cache-Control": "no-cache",
                      "X-Request-ID": rid})
+        if resume_ids is not None:
+            # How much generated work the failover preserved — the
+            # router mirrors it into router_resume_replay_tokens.
+            resp.headers["X-Resume-Replayed"] = str(len(resume_ids))
         try:
             await resp.prepare(request)
         except BaseException:

@@ -27,6 +27,17 @@ class IncrementalDetokenizer:
         self._read = 0          # ids in [prefix:read] produced emitted text
         self._text = ""         # everything emitted so far
 
+    def prime(self, ids: list[int]) -> None:
+        """Seed already-emitted context (the failover resume path): the
+        replayed ids count as fully emitted — ``push`` decodes new
+        tokens against this tail window (sentencepiece space handling
+        stays correct across the resume boundary) while ``text`` and
+        future chunks carry only NEW text, so the sibling never
+        re-streams what the caller already received."""
+        self._ids = [int(i) for i in ids]
+        self._read = len(self._ids)
+        self._prefix = max(0, self._read - 8)
+
     def push(self, token_id: int) -> str:
         self._ids.append(token_id)
         window = self._ids[self._prefix:]

@@ -78,6 +78,7 @@ from ..utils.errors import (ConfigError, EngineError, RoleMismatchError,
 from ..utils.hbm import peak_bw
 from ..utils.logging import get_logger, log_event
 from . import kv_tier as kv_tier_mod
+from . import resume as engine_resume
 from .detokenizer import IncrementalDetokenizer, StopWordTrap
 from .kv_tier import BlockRecord, KVTier
 from .prefix_cache import PrefixCache, hash_blocks, usable_prefix_tokens
@@ -187,6 +188,18 @@ _STATS_TEMPLATE = {
     # handoffs can't stall this engine's decode rounds).
     "kv_tier_export_pages": 0,
     "kv_export_shed": 0,
+    # KV blob integrity (engine/kv_tier.py v2 wire format): transfer /
+    # handoff / session blobs whose per-array CRC32 (or framing) failed
+    # verification — each one fell back cleanly to recompute instead of
+    # admitting garbage pages. 0 on a healthy network.
+    "kv_restore_corrupt": 0,
+    # Liveness watchdog (ENGINE_WATCHDOG_STALL_S): times the watchdog
+    # declared the engine stalled — work queued or in flight while the
+    # round/harvest progress counters stayed frozen past the threshold.
+    # Each detection dumps thread stacks + the last round record via a
+    # structured ``engine_watchdog_stall`` log event and flips /health
+    # to 503 until progress resumes.
+    "watchdog_stalls": 0,
     # Round telemetry (obs/rounds.py): engine rounds whose plan AND
     # every harvested device output have been recorded — the flight-
     # recorder-style per-round records behind GET /debug/rounds.
@@ -567,6 +580,12 @@ class _Request:
     drafter: Optional[PromptLookupDrafter] = None
     spec_ctrl: Optional[AdaptiveDraftController] = None
     base_len: int = 0
+    # Failover resume (engine/resume.py): how many trailing prompt_ids
+    # are REPLAYED generated tokens from a dead sibling's transcript.
+    # None for ordinary requests. Pins the admission RNG key to
+    # (seed, offset) instead of the global step counter, so a resumed
+    # request with the same seed draws the same continuation stream.
+    resume_offset: Optional[int] = None
 
     @property
     def done(self) -> bool:
@@ -823,6 +842,16 @@ class Engine:
         self._stopped = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._harvest_thread: Optional[threading.Thread] = None
+        # Liveness watchdog (docs/robustness.md): work queued/in-flight
+        # while the progress counters stay frozen past the threshold
+        # flips ``stalled`` (chains/server.py /health answers 503 on it)
+        # and dumps thread stacks. 0 disables — the default, because a
+        # legitimate first-time compile on a slow host looks exactly
+        # like a stall to any timer.
+        self._watchdog_stall_s = float(os.environ.get(
+            "ENGINE_WATCHDOG_STALL_S", "0") or 0)
+        self._watchdog_thread: Optional[threading.Thread] = None
+        self._stalled = False
         self._fatal: Optional[BaseException] = None
         # Loop generation: reset() bumps it to disown wedged threads —
         # a stale loop drops its writes and exits when it unsticks.
@@ -2087,6 +2116,12 @@ class Engine:
                 name="engine-harvest")
             self._harvest_thread._engine_gen = self._gen  # type: ignore[attr-defined]
             self._harvest_thread.start()
+        if self._watchdog_thread is None and self._watchdog_stall_s > 0:
+            self._watchdog_thread = threading.Thread(
+                target=self._watchdog_loop, daemon=True,
+                name="engine-watchdog")
+            self._watchdog_thread._engine_gen = self._gen  # type: ignore[attr-defined]
+            self._watchdog_thread.start()
 
     def stop(self) -> None:
         self._stopped.set()
@@ -2111,7 +2146,84 @@ class Engine:
                     "harvest worker did not stop within 30s; call reset() "
                     "to abandon it and rebuild the device state")
             self._harvest_thread = None
+        if self._watchdog_thread is not None:
+            self._watchdog_thread.join(timeout=5)
+            self._watchdog_thread = None
+            self._stalled = False
         self._drain_on_stop()
+
+    # ------------------------------------------------------------- watchdog
+
+    @property
+    def stalled(self) -> bool:
+        """Liveness-watchdog verdict: True while work is queued or in
+        flight but no progress counter has moved for
+        ``ENGINE_WATCHDOG_STALL_S`` (docs/robustness.md). The chain
+        server's /health answers 503 on it — truthful readiness, so the
+        fleet router places elsewhere — and it clears by itself the
+        moment a round completes again."""
+        return self._stalled
+
+    def _progress_marks(self) -> tuple:
+        """The counters any live engine moves: one frozen sweep of these
+        with work pending is the stall signature."""
+        with self._stats_lock:
+            s = self._stats
+            return (s["rounds_completed"], s["harvest_rounds"],
+                    s["first_readbacks"], s["prefills"],
+                    s["tokens_generated"])
+
+    def _work_pending(self) -> bool:
+        with self._pipe_lock:
+            inflight = self._inflight_rounds
+        return (inflight > 0 or len(self._backlog) > 0
+                or self._pending.qsize() > 0)
+
+    def _watchdog_loop(self) -> None:
+        import sys
+        import traceback
+
+        gen = self._gen
+        poll = max(0.05, min(1.0, self._watchdog_stall_s / 4.0))
+        marks = self._progress_marks()
+        last_move = time.monotonic()
+        while not self._stopped.wait(poll):
+            if gen != self._gen:
+                return  # disowned by reset()
+            now = time.monotonic()
+            cur = self._progress_marks()
+            if cur != marks or not self._work_pending():
+                if self._stalled:
+                    self._stalled = False
+                    log_event(logger, "engine_watchdog_recovered",
+                              stalled_s=round(now - last_move, 2))
+                marks = cur
+                last_move = now
+                continue
+            if self._stalled or now - last_move < self._watchdog_stall_s:
+                continue
+            # Stall declared: work is pending and nothing has moved for
+            # the whole threshold. Dump every thread's stack + the last
+            # round record — the post-mortem an operator needs when the
+            # process is about to be killed — and flip readiness.
+            self._stalled = True
+            self._bump("watchdog_stalls")
+            names = {t.ident: t.name for t in threading.enumerate()}
+            stacks = {
+                f"{names.get(tid, '?')}:{tid}":
+                    "".join(traceback.format_stack(frame))[-2000:]
+                for tid, frame in sys._current_frames().items()}
+            try:
+                last_round = self.rounds.snapshot(limit=1).get("records")
+            except Exception:  # noqa: BLE001 — diagnostics must not throw
+                last_round = None
+            log_event(logger, "engine_watchdog_stall",
+                      stall_s=round(now - last_move, 2),
+                      threshold_s=self._watchdog_stall_s,
+                      queue_waiting=(len(self._backlog)
+                                     + self._pending.qsize()),
+                      inflight_rounds=self._inflight_rounds,
+                      last_round=last_round, stacks=stacks)
 
     def reset(self) -> None:
         """Recover from a wedged loop: disown the stuck threads (their
@@ -2133,6 +2245,8 @@ class Engine:
         self._gen += 1
         self._thread = None
         self._harvest_thread = None
+        self._watchdog_thread = None
+        self._stalled = False
         exc = EngineError("engine was reset")
         for req in self._live_requests():
             if not req.done:
@@ -2587,9 +2701,25 @@ class Engine:
                 f"{self.cfg.max_input_length}")
         if len(prompt_ids) == 0:
             raise EngineError("empty prompt")
-        eff_max = min(params.max_tokens,
-                      self.cfg.max_cache_len - len(prompt_ids))
-        need = _ceil_div(len(prompt_ids) + eff_max, self.cfg.page_size)
+        # Failover resume (engine/resume.py, docs/robustness.md): a
+        # router-replayed continuation admits as prompt + generated-so-
+        # far tokens. The replayed tokens are PROMPT from here on — the
+        # prefix cache / host-tier restore / donor transfer make them
+        # cheap, the rep-penalty seen mask covers them exactly like any
+        # prefix-cache hit, and the stream emits only NEW tokens. The
+        # max_input_length bound above applies to the ORIGINAL prompt:
+        # the replayed tail was legitimately generated output.
+        rz = engine_resume.current_resume()
+        replay_ids = [int(t) for t in (rz or {}).get("ids", ())]
+        full_ids = list(prompt_ids) + replay_ids
+        eff_max = min(params.max_tokens - len(replay_ids),
+                      self.cfg.max_cache_len - len(full_ids))
+        if replay_ids and eff_max < 1:
+            raise EngineError(
+                f"resume replays {len(replay_ids)} tokens but the "
+                f"request has no token budget left "
+                f"(max_tokens={params.max_tokens})")
+        need = _ceil_div(len(full_ids) + eff_max, self.cfg.page_size)
         if need > self._n_pages - 1:
             raise EngineError(
                 f"request needs {need} KV pages but the pool only has "
@@ -2597,10 +2727,10 @@ class Engine:
         banned_ids, bad_seqs = self._compile_bad_words(params)
         banned_np, bad_seq_np, bad_len_np = self._render_bad_words(
             banned_ids, bad_seqs)
-        stream = self._new_stream(request_id, len(prompt_ids), eff_max)
-        req = _Request(stream=stream, prompt_ids=list(prompt_ids),
+        stream = self._new_stream(request_id, len(full_ids), eff_max)
+        req = _Request(stream=stream, prompt_ids=full_ids,
                        params=params, eff_max=eff_max,
-                       extent=len(prompt_ids) + eff_max,
+                       extent=len(full_ids) + eff_max,
                        detok=IncrementalDetokenizer(self.tokenizer),
                        stop=StopWordTrap(params.stop_words),
                        greedy=(params.top_k == 1 or params.temperature <= 0),
@@ -2609,12 +2739,30 @@ class Engine:
                        bad_len_np=bad_len_np,
                        deadline_t=self._resolve_deadline(stream, deadline_t),
                        seq=next(self._arrival_seq),
-                       base_len=len(prompt_ids))
+                       base_len=len(full_ids),
+                       resume_offset=(len(replay_ids) if replay_ids
+                                      else None))
+        if replay_ids:
+            # Fresh stop-word trap is CORRECT here: any held-back
+            # stop-word prefix on the dead replica never reached the
+            # router's transcript, so the replayed text ends before it
+            # and the trap re-accumulates the straddle from the new
+            # tokens. The detokenizer seeds the replayed tail as
+            # already-emitted context so only new text streams.
+            req.detok.prime(replay_ids)
+            tl = stream.timeline
+            if tl is not None:
+                tl.annotate(resume_replayed=len(replay_ids),
+                            resume_attempt=int((rz or {}).get("attempt",
+                                                              1)))
+                tl.event("resume_admit", {"replayed": len(replay_ids)})
         if self._spec is not None:
             # Prompt-lookup index built on the SUBMITTING thread (like
-            # the bad-words masks): the serve loop only proposes.
+            # the bad-words masks): the serve loop only proposes. On a
+            # resume, the replayed tokens index too — the uninterrupted
+            # run would have indexed them as generated output.
             req.drafter = PromptLookupDrafter(
-                prompt_ids, ngram_max=self._spec.ngram_max,
+                full_ids, ngram_max=self._spec.ngram_max,
                 ngram_min=self._spec.ngram_min)
             req.spec_ctrl = AdaptiveDraftController(self._spec)
         if self._kv_tier is not None:
@@ -2897,7 +3045,8 @@ class Engine:
             return
         got = kv_tier_mod.fetch_blocks(
             src, missing, timeout_s=tier.transfer_timeout_s,
-            max_pages=tier.transfer_max_pages)
+            max_pages=tier.transfer_max_pages,
+            on_corrupt=lambda: self._bump("kv_restore_corrupt"))
         if not got:
             return
         meta, records = got
@@ -3073,6 +3222,10 @@ class Engine:
         try:
             meta, records = kv_tier_mod.from_blob(blob)
         except (ValueError, KeyError, TypeError) as exc:
+            # Corrupt or malformed import (session resume, handoff
+            # push): counted, then refused loudly — the sender's
+            # fallback is recompute, never garbage pages in our pool.
+            self._bump("kv_restore_corrupt")
             raise EngineError(f"malformed KV blob: {exc}") from exc
         if not self._kv_tier.compatible(meta):
             raise EngineError(
@@ -3870,8 +4023,21 @@ class Engine:
         # uploaded; don't pin ~vocab-size bytes per request for the
         # rest of its lifetime (queue depth x 128k-vocab rows adds up)
         req.banned_np = req.bad_seq_np = req.bad_len_np = None
-        key = jax.random.fold_in(self._base_key,
-                                 next(self._step_counter) ^ sp.random_seed)
+        if req.resume_offset is not None:
+            # Failover resume (docs/robustness.md): the admission key
+            # must be a pure function of (seed, replay offset) — the
+            # global step counter would make the continuation's first
+            # draw depend on unrelated admissions, breaking the "same
+            # seed ⇒ same continuation" resume contract. The offset
+            # salt keeps a resume at offset N distinct from both a
+            # fresh request and a resume at a different boundary.
+            key = jax.random.fold_in(
+                self._base_key,
+                ((req.resume_offset + 1) << 20) ^ sp.random_seed)
+        else:
+            key = jax.random.fold_in(
+                self._base_key,
+                next(self._step_counter) ^ sp.random_seed)
         # Chunk-window geometry (only the chunked path reads it): the
         # gather window must cover the PADDED chunk span, not just the
         # request extent — a chunk whose padding runs past the window

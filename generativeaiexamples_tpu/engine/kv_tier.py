@@ -41,9 +41,10 @@ from __future__ import annotations
 
 import json
 import threading
+import zlib
 from contextvars import ContextVar
 from dataclasses import dataclass, field
-from typing import Optional, Sequence
+from typing import Callable, Optional, Sequence
 
 import numpy as np
 
@@ -54,7 +55,13 @@ logger = get_logger(__name__)
 
 #: Wire/blob format magic + version. Bumped on any layout change; a
 #: reader rejects unknown versions loudly instead of mis-slicing bytes.
-BLOB_MAGIC = b"GAIEKV1\n"
+#: v1 carries no checksums; v2 adds a CRC32 per array section so a
+#: bit-flip anywhere on the network/store path is a loud ValueError
+#: (counted clean fallback to recompute), never garbage KV pages. The
+#: writer emits v2; the reader accepts both, so blobs suspended under
+#: v1 still resume.
+BLOB_MAGIC_V1 = b"GAIEKV1\n"
+BLOB_MAGIC = b"GAIEKV2\n"
 
 
 def _np_dtype(name: str) -> np.dtype:
@@ -182,9 +189,13 @@ def to_blob(records: Sequence[BlockRecord], meta: dict) -> bytes:
         arrays = {}
         for name in sorted(rec.arrays):
             arr = np.ascontiguousarray(rec.arrays[name])
+            raw = arr.tobytes()
             arrays[name] = {"dtype": arr.dtype.name,
-                            "shape": list(arr.shape)}
-            payload += arr.tobytes()
+                            "shape": list(arr.shape),
+                            # v2 integrity: CRC32 of this array section's
+                            # raw bytes, verified on parse.
+                            "crc32": zlib.crc32(raw) & 0xFFFFFFFF}
+            payload += raw
         header["blocks"].append({
             "hash": rec.hash.hex(),
             "parent": rec.parent.hex() if rec.parent else None,
@@ -197,9 +208,14 @@ def to_blob(records: Sequence[BlockRecord], meta: dict) -> bytes:
 
 def from_blob(blob: bytes) -> tuple[dict, list[BlockRecord]]:
     """Parse :func:`to_blob` output; raises ValueError on anything that
-    is not a well-formed v1 blob (truncation included — a short read
-    must fail loudly, never hand back silently-garbled KV)."""
-    if not blob.startswith(BLOB_MAGIC):
+    is not a well-formed v1/v2 blob (truncation included — a short read
+    must fail loudly, never hand back silently-garbled KV). v2 sections
+    additionally verify their per-array CRC32, so corruption anywhere
+    between the donor's memory and ours is detected here, before a
+    single page reaches the pool; v1 blobs (no checksums) still parse
+    for back-compat with already-suspended sessions."""
+    if not (blob.startswith(BLOB_MAGIC)
+            or blob.startswith(BLOB_MAGIC_V1)):
         raise ValueError("not a KV-tier blob (bad magic)")
     off = len(BLOB_MAGIC)
     head_len = int.from_bytes(blob[off:off + 8], "little")
@@ -215,8 +231,16 @@ def from_blob(blob: bytes) -> tuple[dict, list[BlockRecord]]:
             n = int(np.prod(shape)) * dtype.itemsize
             if off + n > len(blob):
                 raise ValueError("truncated KV-tier blob")
-            arrays[name] = np.frombuffer(
-                blob[off:off + n], dtype=dtype).reshape(shape)
+            section = blob[off:off + n]
+            want = spec.get("crc32")
+            if want is not None \
+                    and (zlib.crc32(section) & 0xFFFFFFFF) != int(want):
+                raise ValueError(
+                    f"KV-tier blob CRC mismatch in block "
+                    f"{b['hash'][:12]} array {name!r} — corrupt in "
+                    f"transit or at rest")
+            arrays[name] = np.frombuffer(section,
+                                         dtype=dtype).reshape(shape)
             off += n
         records.append(BlockRecord(
             hash=bytes.fromhex(b["hash"]),
@@ -327,13 +351,17 @@ def donor_allowed(url: str) -> bool:
 
 
 def fetch_blocks(url: str, hashes: Sequence[bytes], *,
-                 timeout_s: float = 5.0, max_pages: int = 32
+                 timeout_s: float = 5.0, max_pages: int = 32,
+                 on_corrupt: Optional[Callable[[], None]] = None
                  ) -> Optional[tuple[dict, list[BlockRecord]]]:
     """Fetch up to ``max_pages`` blocks from a sibling replica's
     ``GET /control/kv_pages``. Returns ``(meta, records)`` or None on
-    ANY failure — timeout, connection error, bad blob. The whole
-    attempt (fault injection point ``kv.transfer`` included) runs on a
-    bounded worker thread: a hung donor costs the caller exactly
+    ANY failure — timeout, connection error, bad blob. A blob that
+    arrives but fails structural/CRC validation additionally invokes
+    ``on_corrupt`` (the engine counts it as ``kv_restore_corrupt``) —
+    corruption is a data-integrity event, not a network hiccup. The
+    whole attempt (fault injection point ``kv.transfer`` included) runs
+    on a bounded worker thread: a hung donor costs the caller exactly
     ``timeout_s`` and a cold prefill, never a wedged request."""
     want = list(hashes)[:max(1, int(max_pages))]
     if not want:
@@ -351,7 +379,10 @@ def fetch_blocks(url: str, hashes: Sequence[bytes], *,
             if resp.status_code != 200 or not resp.content:
                 box["result"] = None
                 return
-            box["result"] = from_blob(resp.content)
+            try:
+                box["result"] = from_blob(resp.content)
+            except (ValueError, KeyError, TypeError) as exc:
+                box["corrupt"] = exc
         except Exception as exc:  # noqa: BLE001 — fetch is best-effort
             box["error"] = exc
 
@@ -359,6 +390,12 @@ def fetch_blocks(url: str, hashes: Sequence[bytes], *,
                          name="kv-transfer-fetch")
     t.start()
     t.join(timeout_s)
+    if "corrupt" in box:
+        logger.warning("kv transfer fetch from %s returned a corrupt "
+                       "blob (%s); placing cold", url, box["corrupt"])
+        if on_corrupt is not None:
+            on_corrupt()
+        return None
     if "error" in box:
         logger.debug("kv transfer fetch from %s failed: %s", url,
                      box["error"])

@@ -1,0 +1,47 @@
+"""Resume-continuation context: how a transcript-replay resume reaches
+``Engine.submit`` without threading a parameter through every chain.
+
+On a mid-stream replica loss the fleet router re-submits the original
+request to a sibling with the generated-so-far transcript attached
+(docs/robustness.md). The chain server tokenizes that transcript and
+binds the replayed token ids here; the bound value rides the request's
+copied context through ``iterate_in_thread`` into ``Engine.submit`` —
+the same contextvar pattern as the flight timeline (``obs/flight.py``)
+and the KV-transfer donor hint (``engine/kv_tier.py``).
+
+``Engine.submit`` reads the block once and admits the request as
+``prompt + replayed tokens``: the replayed prefix is PROMPT, so the
+prefix cache / host-tier restore / donor transfer make it cheap, the
+rep-penalty seen mask covers it exactly as prefix-cache admission
+already does, and the detokenizer/stop-trap stream only NEW text. The
+replay offset also pins the admission RNG key (``_admit``) so a resumed
+request with the same seed draws the same continuation stream where the
+sampler consumes per-request randomness.
+"""
+
+from __future__ import annotations
+
+import contextvars
+from typing import Optional
+
+#: ``{"ids": [int, ...], "attempt": int}`` — replayed generated-so-far
+#: token ids (NO BOS; they follow the prompt) and the resume attempt
+#: ordinal (observability only).
+_RESUME: contextvars.ContextVar[Optional[dict]] = contextvars.ContextVar(
+    "engine_resume_block", default=None)
+
+
+def bind_resume(block: dict) -> contextvars.Token:
+    """Bind a resume block for the current context; returns the token
+    for ``unbind_resume``. The caller (chains/server.py) binds before
+    starting the chain generator and unbinds in its ``finally``."""
+    return _RESUME.set(dict(block))
+
+
+def unbind_resume(token: contextvars.Token) -> None:
+    _RESUME.reset(token)
+
+
+def current_resume() -> Optional[dict]:
+    """The bound resume block, or None for an ordinary request."""
+    return _RESUME.get()

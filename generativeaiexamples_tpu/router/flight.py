@@ -92,6 +92,93 @@ def _env_float(name: str, default: float) -> float:
         return default
 
 
+class Transcript:
+    """Bounded per-request generation transcript: every byte the router
+    has forwarded downstream for one ``/generate`` stream, held to clean
+    UTF-8 boundaries.
+
+    This is the dedupe boundary of mid-stream failover
+    (docs/robustness.md): on upstream loss the router re-submits the
+    request with ``text`` as the generated-so-far continuation, and the
+    sibling streams only what comes AFTER it — so the transcript must
+    equal EXACTLY what the caller has seen. ``push`` therefore withholds
+    a trailing incomplete UTF-8 sequence (HTTP chunking can split a
+    multibyte character across TCP segments even though the engine's
+    detokenizer only emits whole characters) from both the caller and
+    the transcript; the ≤3-byte tail is flushed on clean EOF or on a
+    failed resume (ahead of the error frame), and DISCARDED on a
+    successful resume — the sibling regenerates that token and the
+    caller receives its full bytes exactly once.
+
+    The buffer is bounded by ``ROUTER_TRANSCRIPT_MAX_BYTES``: past the
+    cap (or on a stream that is not UTF-8 at all) the transcript stops
+    accumulating and marks itself ``overflowed`` — forwarding continues
+    untouched, resume is simply off for this request (outcome
+    ``overflow`` in ``router_resume_total``).
+    """
+
+    def __init__(self, max_bytes: Optional[int] = None):
+        self.max_bytes = (max_bytes if max_bytes is not None
+                          else int(_env_float(
+                              "ROUTER_TRANSCRIPT_MAX_BYTES", 262144)))
+        self._buf = bytearray()
+        self._pending = b""
+        self.overflowed = False
+
+    @staticmethod
+    def _clean_cut(data: bytes) -> int:
+        """Length of the longest prefix that is complete UTF-8; -1 when
+        even holding back 3 bytes leaves the tail undecodable (the
+        stream is not UTF-8 — transcripting is meaningless)."""
+        for cut in range(len(data), max(len(data) - 3, 0) - 1, -1):
+            try:
+                data[:cut].decode("utf-8")
+                return cut
+            except UnicodeDecodeError:
+                continue
+        return -1
+
+    def push(self, chunk: bytes) -> bytes:
+        """Absorb one upstream chunk; returns the bytes to forward to
+        the caller now (everything up to the last clean UTF-8
+        boundary)."""
+        data = self._pending + chunk
+        cut = self._clean_cut(data)
+        if cut < 0:
+            # Not UTF-8: forward verbatim, stop transcripting.
+            self.overflowed = True
+            self._buf.clear()
+            self._pending = b""
+            return data
+        out, self._pending = data[:cut], data[cut:]
+        if not self.overflowed:
+            if len(self._buf) + len(out) > self.max_bytes:
+                self.overflowed = True
+                self._buf.clear()
+            else:
+                self._buf += out
+        return out
+
+    def flush(self) -> bytes:
+        """Release the held-back tail (clean EOF / failed resume)."""
+        out, self._pending = self._pending, b""
+        return out
+
+    def discard_pending(self) -> None:
+        """Drop the held-back tail (successful resume: the sibling
+        regenerates the token those bytes came from)."""
+        self._pending = b""
+
+    @property
+    def size(self) -> int:
+        return len(self._buf)
+
+    @property
+    def text(self) -> str:
+        """The generated-so-far text — what the caller has seen."""
+        return bytes(self._buf).decode("utf-8")
+
+
 class SloWindow:
     """Recency-windowed per-replica outcome ring (see module docstring).
 

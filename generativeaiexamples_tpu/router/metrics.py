@@ -158,6 +158,23 @@ ROUTER_METRICS: dict[str, tuple[str, tuple[str, ...], str]] = {
         "ROUTER_DISAGG_PREFILL_TIMEOUT_S), no_pages (prefill replica "
         "exported nothing) — each one served correctly via recompute, "
         "just without the TTFT win"),
+    "router_resume_total": (
+        "counter", ("outcome",),
+        "mid-stream failover resumes attempted after a replica died on "
+        "a 200, by outcome: ok (sibling continued the stream; the "
+        "caller never saw an error frame), no_replica (no placeable "
+        "sibling), rejected (sibling answered non-200), connect_fail "
+        "(sibling unreachable), overflow (transcript exceeded "
+        "ROUTER_TRANSCRIPT_MAX_BYTES so replay was off), "
+        "budget_exhausted (ROUTER_RESUME_ATTEMPTS already spent) — "
+        "every non-ok outcome falls back to the classic replica_lost "
+        "error frame (docs/robustness.md)"),
+    "router_resume_replay_tokens": (
+        "gauge", (),
+        "replayed generated-so-far tokens admitted by the sibling on "
+        "the most recent successful resume (from its X-Resume-Replayed "
+        "header) — how much completed work the failover preserved "
+        "instead of re-billing the client for"),
 }
 
 

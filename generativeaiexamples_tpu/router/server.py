@@ -24,12 +24,25 @@ Failure semantics (the part routers get wrong):
   refused before doing any work) and additionally marks the replica
   draining immediately — the router need not wait for the next
   heartbeat to stop placing on it.
-- **Mid-stream replica loss is never retried** (tokens already went
-  out on a 200). The router appends the chain server's machine-readable
-  error-frame contract (``\\n[error] ...`` + ``event: error`` JSON with
+- **Mid-stream replica loss is RESUMED, not retried** (docs/
+  robustness.md): a replay of the whole request could double-run the
+  generation, but the router holds the full generation transcript
+  (every byte it forwarded, held to clean UTF-8 boundaries —
+  ``flight.Transcript``), so it re-places on a sibling (dead replica
+  excluded, DRAINING siblings eligible — a resume is the continuation
+  of an already-accepted stream) and re-submits the original body plus
+  the transcript as a ``resume`` continuation block. The sibling admits
+  it as prompt + generated prefix and streams only what comes AFTER the
+  transcript — the transcript is the dedupe boundary; the caller sees
+  no error frame, no duplicated and no dropped token. Bounded by
+  ``ROUTER_RESUME_ATTEMPTS`` (default 1; 0 restores the classic
+  behavior byte-for-byte). Exhausted budget / no sibling / sibling
+  rejection falls back to the classic machine-readable error-frame
+  contract (``\\n[error] ...`` + ``event: error`` JSON with
   ``type=replica_lost``) so clients parse a real failure instead of
-  seeing a silent truncation, records the breaker failure, and marks
-  the replica unreachable so the NEXT request places elsewhere at once.
+  seeing a silent truncation. Either way the dead replica's breaker
+  records the failure and it is marked unreachable so the NEXT request
+  places elsewhere at once.
 - Any other upstream HTTP status is relayed as-is — the replica's 429 /
   503 / 504 taxonomy (docs/robustness.md) already says the right thing;
   the router adds only ``503 no_replicas`` (nothing placeable) and
@@ -88,7 +101,7 @@ from ..utils.logging import get_logger
 from . import autoscale as router_autoscale
 from . import fleet as router_fleet
 from . import metrics as router_metrics
-from .flight import RouterFlightRecorder
+from .flight import RouterFlightRecorder, Transcript
 from .table import ReplicaTable, handoff_beats_prefill
 
 logger = get_logger(__name__)
@@ -171,6 +184,8 @@ class FleetRouter:
                  disagg_min_prompt_bytes: int = 4096,
                  disagg_prefill_timeout_s: float = 30.0,
                  heartbeat_jitter: float = 0.2,
+                 resume_attempts: int = 1,
+                 heartbeat_max_backoff_s: float = 30.0,
                  flight: Optional[RouterFlightRecorder] = None,
                  surge: Optional[router_autoscale.SurgeGate] = None):
         self.table = table
@@ -202,6 +217,21 @@ class FleetRouter:
         # heartbeat_s * U(1-j, 1+j), so N routers polling one fleet (or
         # one router's restarts) never phase-lock their probe bursts.
         self.heartbeat_jitter = min(0.9, max(0.0, float(heartbeat_jitter)))
+        # Mid-stream failover (docs/robustness.md): how many times ONE
+        # request's stream may be resumed on a sibling after its replica
+        # died on a 200. 0 = off (classic replica_lost error frame,
+        # byte-for-byte — no transcript is even kept).
+        self.resume_attempts = max(0, int(resume_attempts))
+        # Heartbeat crash-loop backoff: consecutive probe failures to
+        # one replica space its probes out exponentially (cap below)
+        # instead of hammering a dead host every sweep. Router-side
+        # state, not table state: the table's heartbeat_failures counter
+        # is CUMULATIVE by contract (the doc-fenced metric mirrors it)
+        # and must not reset on recovery.
+        self.heartbeat_max_backoff_s = max(
+            0.0, float(heartbeat_max_backoff_s))
+        self._hb_fail_streak: dict[str, int] = {}
+        self._hb_next_t: dict[str, float] = {}
         # Surge admission (router/autoscale.py): counts in-flight
         # forwards always; gates only while the autoscaler (or an
         # operator) flips it active.
@@ -262,16 +292,46 @@ class FleetRouter:
         j = self.heartbeat_jitter
         return self.heartbeat_s * random.uniform(1.0 - j, 1.0 + j)
 
-    async def heartbeat_once(self) -> None:
-        """Probe every replica's /health concurrently. Each probe is
+    async def heartbeat_once(self, force: bool = False) -> None:
+        """Probe every DUE replica's /health concurrently. Each probe is
         bounded by its OWN timeout (the HTTP client timeout plus slack
         for injected stalls), so one wedged replica costs the sweep at
         most that bound — its siblings' health lands the moment their
-        probes return, never behind the straggler's."""
+        probes return, never behind the straggler's.
+
+        A replica whose probes keep failing is in exponential backoff
+        (``_hb_update_backoff``) and is skipped until its next-probe
+        time arrives; ``force=True`` (the ``/control/heartbeat``
+        endpoint — an operator asking NOW) probes everyone regardless."""
         reps = self.table.replicas()
         if not reps:
             return
-        await asyncio.gather(*(self._probe_bounded(r) for r in reps))
+        now = time.monotonic()
+        due = [r for r in reps
+               if force or self._hb_next_t.get(r.name, 0.0) <= now]
+        if not due:
+            return
+        await asyncio.gather(*(self._probe_bounded(r) for r in due))
+        for r in due:
+            self._hb_update_backoff(r)
+
+    def _hb_update_backoff(self, rep) -> None:
+        """Crash-loop backoff bookkeeping after one probe: a failure
+        doubles the spacing to this replica (``heartbeat_s * 2^(n-1)``,
+        capped at ``heartbeat_max_backoff_s``); any successful probe
+        resets it to the normal sweep cadence. Skipped sweeps do NOT
+        advance ``last_heartbeat_t``, so ``router_heartbeat_age_seconds``
+        keeps growing for a backed-off replica — the age gauge's
+        semantics (seconds since the last OBSERVATION) are unchanged."""
+        if rep.reachable:
+            self._hb_fail_streak.pop(rep.name, None)
+            self._hb_next_t.pop(rep.name, None)
+            return
+        streak = self._hb_fail_streak.get(rep.name, 0) + 1
+        self._hb_fail_streak[rep.name] = streak
+        backoff = min(self.heartbeat_max_backoff_s,
+                      self.heartbeat_s * (2 ** (streak - 1)))
+        self._hb_next_t[rep.name] = time.monotonic() + backoff
 
     async def _probe_bounded(self, rep) -> None:
         try:
@@ -350,6 +410,8 @@ class FleetRouter:
                         "%.1fs budget; removing anyway", name, wait_s)
         self.table.remove(name)
         self.flight.slo.forget(name)
+        self._hb_fail_streak.pop(name, None)
+        self._hb_next_t.pop(name, None)
         return True
 
     async def _drain_in_flight(self, rep) -> Optional[int]:
@@ -619,7 +681,8 @@ class FleetRouter:
             tl.stage("router_connect", time.monotonic() - t_conn)
             try:
                 return await self._relay(request, rep, upstream, rid,
-                                         blocks, tried, tl)
+                                         blocks, tried, tl,
+                                         raw=raw, fwd_headers=fwd_headers)
             except _RetryNextReplica as retry:
                 last_err = f"{rep.name}: {retry.reason}"
                 fallback = retry.response
@@ -651,14 +714,26 @@ class FleetRouter:
     async def _relay(self, request: web.Request, rep,
                      upstream: aiohttp.ClientResponse, rid: str,
                      blocks: Sequence[bytes],
-                     tried: Sequence[str],
-                     tl=None) -> web.StreamResponse:
+                     tried: list,
+                     tl=None, *,
+                     raw: bytes = b"",
+                     fwd_headers: Optional[dict] = None
+                     ) -> web.StreamResponse:
         """Stream one upstream answer back; raises _RetryNextReplica for
         the one retry-safe HTTP answer (429 draining, pre-work). ``tl``
         is the request's router timeline — first upstream body byte
         stamps the router-observed TTFT, and the terminal transition
         (stream end / mid-stream loss / caller disconnect / relayed
-        error status) retires it into the SLO window."""
+        error status) retires it into the SLO window.
+
+        With failover on (``resume_attempts > 0``) a ``/generate``
+        stream keeps a :class:`~.flight.Transcript` of every byte
+        forwarded; on mid-stream loss the stream is resumed on a sibling
+        (``_attempt_resume``) and the caller never sees the seam —
+        ``raw``/``fwd_headers`` are kept for exactly that re-submission.
+        A resumed request that completes is an ``ok`` outcome attributed
+        to the FINISHING replica, not a ``midstream_loss`` (the dead
+        replica still pays breaker + unreachable)."""
         try:
             if upstream.status == 429:
                 data = await upstream.read()
@@ -704,6 +779,16 @@ class FleetRouter:
                     resp.headers[h] = upstream.headers[h]
             resp.headers["X-Routed-Replica"] = rep.name
             await resp.prepare(request)
+            # Generation transcript (docs/robustness.md): every byte
+            # forwarded downstream, held to clean UTF-8 boundaries —
+            # the resume continuation AND its dedupe boundary. Only
+            # kept when failover could use it; with resume off the
+            # stream path below is byte-for-byte the classic one.
+            transcript = (Transcript()
+                          if (self.resume_attempts > 0
+                              and request.path == "/generate")
+                          else None)
+            resume_attempt = 0
             # Upstream reads and downstream writes fail for OPPOSITE
             # reasons and must not share an except: a read failure is
             # the REPLICA dying (breaker + unreachable + error frame); a
@@ -721,17 +806,38 @@ class FleetRouter:
                 except (aiohttp.ClientError, ConnectionError,
                         asyncio.TimeoutError) as exc:
                     # Replica died mid-stream: tokens already went out
-                    # on a 200, so NO retry — degrade with the
-                    # machine-readable error frame (chat_client parses
-                    # it into last_error) and stop placing here
+                    # on a 200, so NO replay of the whole request. The
+                    # dead replica pays either way: breaker failure +
+                    # unreachable, so the NEXT request places elsewhere
                     # immediately.
                     rep.breaker.record_failure()
                     self.table.mark_unreachable(rep.name)
                     logger.warning("replica %s lost mid-stream: %s",
                                    rep.name, exc)
-                    outcome = "midstream_loss"
                     if tl is not None:
                         tl.event("midstream_loss", rep.name)
+                    # Failover (docs/robustness.md): resume the stream
+                    # on a sibling from the transcript. On success the
+                    # caller's stream simply continues — swap upstream
+                    # and keep relaying.
+                    if transcript is not None:
+                        resume_attempt += 1
+                        new_up, new_rep = await self._attempt_resume(
+                            rep, rid, raw, fwd_headers or {}, blocks,
+                            tried, transcript, resume_attempt, tl)
+                        if new_up is not None:
+                            upstream.release()
+                            upstream, rep = new_up, new_rep
+                            chunks = upstream.content.iter_any()
+                            continue
+                    # No resume: degrade with the machine-readable
+                    # error frame (chat_client parses it into
+                    # last_error), flushing the transcript's held-back
+                    # tail first — the caller gets every byte the dead
+                    # replica generated, then the failure.
+                    outcome = "midstream_loss"
+                    tail = (transcript.flush() if transcript is not None
+                            else b"")
                     frame = (f"\n[error] replica {rep.name} lost "
                              f"mid-stream"
                              + "\n\nevent: error\ndata: " + json.dumps(
@@ -741,13 +847,19 @@ class FleetRouter:
                                   "replica": rep.name,
                                   "request_id": rid}) + "\n\n")
                     try:
-                        await resp.write(frame.encode("utf-8"))
+                        await resp.write(tail + frame.encode("utf-8"))
                     except (ConnectionError, ConnectionResetError):
                         pass  # caller gone too
                     break
                 # First upstream body byte = the router-observed TTFT
                 # (idempotent; only the first chunk stamps it).
                 self.flight.first_byte(tl)
+                if transcript is not None:
+                    # Forward only up to a clean UTF-8 boundary; the
+                    # held-back tail (<= 3 bytes) goes out on EOF.
+                    chunk = transcript.push(chunk)
+                    if not chunk:
+                        continue
                 try:
                     await resp.write(chunk)
                 except (ConnectionError, ConnectionResetError) as exc:
@@ -759,6 +871,13 @@ class FleetRouter:
                     upstream.close()
                     outcome = "disconnect"
                     break
+            if transcript is not None and outcome == "ok":
+                tail = transcript.flush()
+                if tail:
+                    try:
+                        await resp.write(tail)
+                    except (ConnectionError, ConnectionResetError):
+                        outcome = "disconnect"
             try:
                 await resp.write_eof()
             except (ConnectionError, ConnectionResetError):
@@ -771,6 +890,117 @@ class FleetRouter:
             return resp
         finally:
             upstream.release()
+
+    async def _attempt_resume(self, dead_rep, rid: str, raw: bytes,
+                              fwd_headers: dict, blocks: Sequence[bytes],
+                              tried: list, transcript: Transcript,
+                              attempt: int, tl
+                              ) -> tuple[
+                                  Optional[aiohttp.ClientResponse],
+                                  Optional[object]]:
+        """One mid-stream resume attempt: place a sibling (draining
+        included — a resume continues an already-accepted stream, the
+        PR-7 rollout contract), re-submit the original body plus the
+        transcript as a ``resume`` continuation block, and return the
+        new 200 upstream to keep relaying from. ``(None, None)`` means
+        the caller falls back to the classic error frame. Every attempt
+        lands a ``router_resume_total{outcome=}`` count and a ``resume``
+        timeline event — the failure legs are observable, never
+        silent."""
+        def _fail(outcome: str, **extra) -> tuple[None, None]:
+            router_metrics.counter("router_resume_total", outcome).inc()
+            if tl is not None:
+                tl.event("resume", dict(extra, outcome=outcome,
+                                        attempt=attempt,
+                                        **{"from": dead_rep.name}))
+            logger.info("resume of %s after %s died mid-stream: %s",
+                        rid, dead_rep.name, outcome)
+            return None, None
+
+        if attempt > self.resume_attempts:
+            return _fail("budget_exhausted")
+        if transcript.overflowed:
+            return _fail("overflow")
+        rep, decision = self.table.place_explained(
+            blocks, exclude=tried, include_draining=True)
+        if rep is None:
+            return _fail("no_replica")
+        tried.append(rep.name)
+        try:
+            body = json.loads(raw) if raw else {}
+        except (ValueError, UnicodeDecodeError):
+            body = {}
+        if not isinstance(body, dict):
+            body = {}
+        body["resume"] = {"text": transcript.text, "attempt": attempt}
+        headers = dict(fwd_headers)
+        headers["Content-Type"] = "application/json"
+        # Deadline carried over, not restarted: the sibling gets what
+        # is LEFT of the caller's budget.
+        deadline_ms = (tl.meta.get("deadline_ms")
+                       if tl is not None else None)
+        if deadline_ms is not None:
+            elapsed_ms = (time.monotonic() - tl.t_start) * 1e3
+            headers["X-Deadline-Ms"] = str(
+                max(1, int(deadline_ms - elapsed_ms)))
+        # Donor hint recomputed for the NEW placement (the dead replica
+        # can't serve pulls): a warm sibling makes the replayed prefix
+        # a priced page fetch instead of a re-prefill.
+        headers.pop("X-KV-Transfer-From", None)
+        if self.kv_transfer and blocks:
+            donor = self.table.transfer_donor(
+                blocks, chosen=rep.name,
+                min_blocks=self.kv_transfer_min_blocks)
+            if donor is not None:
+                headers["X-KV-Transfer-From"] = donor
+        t0 = time.monotonic()
+        try:
+            assert self._session is not None
+            upstream = await self._session.post(
+                rep.url + "/generate",
+                data=json.dumps(body).encode("utf-8"), headers=headers,
+                timeout=aiohttp.ClientTimeout(
+                    total=self.forward_timeout_s,
+                    sock_connect=self.connect_timeout_s))
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:  # noqa: BLE001 — any resume-leg failure
+            rep.breaker.record_failure()
+            return _fail("connect_fail", to=rep.name, error=str(exc))
+        if upstream.status != 200:
+            reason = ""
+            try:
+                reason = json.loads(
+                    await upstream.read())["error"]["type"]
+            except Exception:  # noqa: BLE001 — not the JSON contract
+                pass
+            upstream.release()
+            return _fail("rejected", to=rep.name, status=upstream.status,
+                         reason=reason)
+        rep.breaker.record_success()
+        self.table.record_placement(rep, blocks)
+        if tl is not None:
+            tl.stage("router_resume", time.monotonic() - t0)
+        replayed = 0
+        try:
+            replayed = int(upstream.headers.get("X-Resume-Replayed", 0))
+        except ValueError:
+            pass
+        router_metrics.counter("router_resume_total", "ok").inc()
+        router_metrics.gauge("router_resume_replay_tokens").set(
+            float(replayed))
+        if tl is not None:
+            tl.event("resume", {"outcome": "ok", "from": dead_rep.name,
+                                "to": rep.name, "attempt": attempt,
+                                "replayed_tokens": replayed})
+            tl.annotate(resumed=attempt, resume_to=rep.name)
+        # The held-back tail belongs to a token the sibling regenerates
+        # (it replays from the transcript, which never included it).
+        transcript.discard_pending()
+        logger.info("resumed %s on %s after %s died mid-stream "
+                    "(%d chars replayed as %d tokens)", rid, rep.name,
+                    dead_rep.name, len(transcript.text), replayed)
+        return upstream, rep
 
     @staticmethod
     def _relay_body(upstream: aiohttp.ClientResponse,
@@ -812,6 +1042,7 @@ def create_router_app(replicas: Sequence[tuple[str, str]] = (), *,
                       heartbeat_s: Optional[float] = None,
                       retry_attempts: Optional[int] = None,
                       kv_transfer: Optional[bool] = None,
+                      resume_attempts: Optional[int] = None,
                       run_heartbeat: bool = True,
                       autoscale: Optional[
                           "router_autoscale.AutoscaleController"] = None,
@@ -827,8 +1058,9 @@ def create_router_app(replicas: Sequence[tuple[str, str]] = (), *,
     ``ROUTER_FORWARD_TIMEOUT_S``, ``ROUTER_KV_TRANSFER`` /
     ``ROUTER_KV_TRANSFER_MIN_BLOCKS`` (docs/router.md),
     ``ROUTER_DISAGG_MIN_PROMPT_BYTES`` /
-    ``ROUTER_DISAGG_PREFILL_TIMEOUT_S`` (docs/disaggregation.md), and
-    the
+    ``ROUTER_DISAGG_PREFILL_TIMEOUT_S`` (docs/disaggregation.md),
+    ``ROUTER_RESUME_ATTEMPTS`` / ``ROUTER_TRANSCRIPT_MAX_BYTES`` /
+    ``ROUTER_HEARTBEAT_MAX_BACKOFF_S`` (docs/robustness.md), and the
     autoscaler/surge knobs (``ROUTER_AUTOSCALE*`` / ``ROUTER_SURGE_*``,
     docs/autoscaling.md). ``autoscale_factory`` builds a controller
     bound to the finished router (``factory(router) -> controller``);
@@ -865,7 +1097,12 @@ def create_router_app(replicas: Sequence[tuple[str, str]] = (), *,
             _env_float("ROUTER_DISAGG_MIN_PROMPT_BYTES", 4096)),
         disagg_prefill_timeout_s=_env_float(
             "ROUTER_DISAGG_PREFILL_TIMEOUT_S", 30.0),
-        heartbeat_jitter=_env_float("ROUTER_HEARTBEAT_JITTER", 0.2))
+        heartbeat_jitter=_env_float("ROUTER_HEARTBEAT_JITTER", 0.2),
+        resume_attempts=(resume_attempts if resume_attempts is not None
+                         else int(_env_float("ROUTER_RESUME_ATTEMPTS",
+                                             1))),
+        heartbeat_max_backoff_s=_env_float(
+            "ROUTER_HEARTBEAT_MAX_BACKOFF_S", 30.0))
 
     if autoscale is None and autoscale_factory is not None:
         autoscale = autoscale_factory(router)
@@ -938,8 +1175,10 @@ def create_router_app(replicas: Sequence[tuple[str, str]] = (), *,
             rep = table.add(name, body["url"])
             # A re-add under a known name is a NEW pod: its window rows
             # (like its sketch and breaker, reset by table.add) must not
-            # carry the old pod's history.
+            # carry the old pod's history — nor its heartbeat backoff.
             router.flight.slo.forget(name)
+            router._hb_fail_streak.pop(name, None)
+            router._hb_next_t.pop(name, None)
             # Probe now: an added replica that is already up starts
             # taking traffic without waiting a full heartbeat period.
             await router._probe(rep)
@@ -986,8 +1225,9 @@ def create_router_app(replicas: Sequence[tuple[str, str]] = (), *,
         raise web.HTTPUnprocessableEntity(text="op must be tick|surge")
 
     async def control_heartbeat(request: web.Request) -> web.Response:
-        """Force one heartbeat cycle now (ops/tests)."""
-        await router.heartbeat_once()
+        """Force one heartbeat cycle now (ops/tests) — probes every
+        replica, crash-loop backoff notwithstanding."""
+        await router.heartbeat_once(force=True)
         router.refresh_fleet()
         return web.json_response({"replicas": table.snapshot()})
 

@@ -250,13 +250,22 @@ class ReplicaTable:
         return rep
 
     def place_explained(self, blocks: Sequence[BlockHash] = (),
-                        exclude: Sequence[str] = ()
+                        exclude: Sequence[str] = (),
+                        include_draining: bool = False
                         ) -> tuple[Optional[Replica], dict]:
         """``place`` plus the decision evidence the router's flight
         recorder stamps on the request timeline: every candidate's
         score, affinity match, and load penalty inputs, and the chosen
         replica's leading-block match — computed under the same lock as
-        the choice, so the explanation is exactly what the scorer saw."""
+        the choice, so the explanation is exactly what the scorer saw.
+
+        ``include_draining`` widens the pool to reachable DRAINING
+        replicas (breaker still respected) — the mid-stream failover
+        resume leg uses it: the PR-7 rollout contract keeps a draining
+        replica serving its accepted streams, and a resume is the
+        continuation of an already-accepted stream, not new work, so a
+        draining sibling is a legitimate rescue target when it is the
+        only one left."""
         with self._lock:
             # Prefill-role replicas never take normal traffic: their
             # admission rejects decode-bound requests anyway (engine
@@ -266,7 +275,11 @@ class ReplicaTable:
             # fleet has no prefill replicas and this filter matches
             # nothing — placement is byte-for-byte today's.
             candidates = [r for r in self._replicas.values()
-                          if r.name not in exclude and r.placeable()
+                          if r.name not in exclude
+                          and (r.placeable()
+                               or (include_draining and r.reachable
+                                   and r.draining
+                                   and r.breaker.state != resilience.OPEN))
                           and r.role != "prefill"]
             decision: dict = {"policy": self.policy,
                               "excluded": list(exclude),

@@ -1,0 +1,123 @@
+"""Tier-1 CPU smoke of the failover bench scenario: a scripted
+mid-stream replica kill under open-loop load, transcript-replay resume
+on vs off, over real tiny-engine replicas behind a real router — plus
+the schema contract for the new ``failover`` section (the
+``failover.*@<arm>`` metrics ``tools/perf_diff.py`` gates on) and the
+preflight validator run over the REAL artifact, not just its synthetic
+twin.
+
+Timing comparisons between the two arms are deliberately NOT asserted
+here — on a CPU tier-1 box the arms are separated by scheduling noise.
+What IS pinned: the resume arm survives the kill with ZERO
+client-visible error frames and >= 1 successful resume, while the
+resume-off arm reproduces the classic in-band error frame on the same
+scripted kill."""
+
+import copy
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import bench
+from generativeaiexamples_tpu.models import llama
+from generativeaiexamples_tpu.models.configs import LlamaConfig
+from generativeaiexamples_tpu.models.tokenizer import ByteTokenizer
+from tools.check_bench_schema import (BenchSchemaError, load_schema,
+                                      validate_result)
+from tools.preflight import validate_failover_block
+
+# Specials (0..2) + the ASCII byte range only: resumed continuations
+# re-tokenize the streamed text, so the smoke uses a vocabulary whose
+# decode/encode round-trips exactly (see tests/test_failover.py).
+CFG = LlamaConfig(vocab_size=131, hidden_size=64,
+                  intermediate_size=128, num_layers=2, num_heads=4,
+                  num_kv_heads=2, head_dim=16,
+                  max_position_embeddings=1024)
+
+
+@pytest.fixture(scope="module")
+def failover_section():
+    from generativeaiexamples_tpu.utils import faults
+    # build_fleet_engines allocates replica KV pools in bfloat16;
+    # params must match or the KV scatter rejects the dtype mix.
+    params = llama.init_params(CFG, jax.random.key(29),
+                               dtype=jnp.bfloat16)
+    try:
+        # Small decode rounds plus the bench's victim-window dispatch
+        # delay keep the victim stream alive well past the killed
+        # server's 0.4 s shutdown grace, so the teardown reliably
+        # severs it MID-stream instead of after the last byte.
+        yield bench.run_failover_bench(
+            params, CFG, ByteTokenizer(), replicas=3, requests=2,
+            rps=8.0, num_tokens=32, seed=3, heartbeat_s=0.3,
+            max_input_length=1024)
+    finally:
+        faults.clear()
+
+
+def _synthetic_with(failover):
+    pipeline = bench.pipeline_snapshot({})
+    return bench.assemble_result(
+        kind="engine", model="llama-tiny", headline=10.0,
+        engine_p50=8.0, engine_p99=12.0, tput=100.0,
+        achieved_bw=1e9, bw_util=0.1, bw_steady=True,
+        chat=None, e2e_p50=None, e2e_dist=None, e2e_breakdown=None,
+        e2e_tps_p50=None, pipeline=pipeline, quant="none", kv_quant=None,
+        weights="random-init", prompt_len=16, out_len=4, slots=2,
+        steps_per_round=4, kv_pool_pages=8, device="cpu", rtt_ms=None,
+        n_devices=1, bench_seconds=1.0, failover=failover)
+
+
+def test_failover_bench_end_to_end(failover_section):
+    section = failover_section
+    assert section["replicas"] == 3
+    assert [a["arm"] for a in section["arms"]] == \
+        ["resume_on", "resume_off"]
+    for arm in section["arms"]:
+        assert arm["offered"] == 3            # 2 open-loop + the victim
+        assert arm["killed_replica"] in ("r0", "r1", "r2")
+        assert 0.0 <= arm["completed_no_error_rate"] <= 1.0
+        assert arm["tokens_generated"] > 0
+    on, off = section["arms"]
+    # the resume arm made the kill invisible: every stream completed
+    # with no in-band error frame, through >= 1 successful resume
+    assert on["resume_attempts"] == 1
+    assert on["resumes_ok"] >= 1
+    assert on["error_frames"] == 0
+    assert on["completed_no_error_rate"] == 1.0
+    assert on["resume_replay_tokens"] > 0
+    assert on["resumed_p50_ms"] is not None
+    assert on["resumed_added_p50_ms"] is not None
+    # the off arm honored the switch and reproduced the classic frame
+    assert off["resume_attempts"] == 0
+    assert off["resumes_ok"] == 0
+    assert off["error_frames"] >= 1
+    assert off["completed_no_error_rate"] < 1.0
+
+
+def test_failover_section_schema_valid(failover_section):
+    validate_result(_synthetic_with(failover_section))
+    validate_result(_synthetic_with(None))  # failover-less runs pass
+
+
+def test_failover_section_matches_schema_keys(failover_section):
+    schema = load_schema()
+    assert set(failover_section) == set(schema["failover"])
+    for arm in failover_section["arms"]:
+        assert set(arm) == set(schema["failover_arm"])
+
+
+def test_failover_real_artifact_passes_preflight(failover_section):
+    # the preflight validator is green on the REAL scenario output,
+    # not only on its synthetic twin
+    assert validate_failover_block(failover_section) == []
+
+
+def test_failover_arm_field_rename_fails_fast(failover_section):
+    section = copy.deepcopy(failover_section)
+    section["arms"][0]["no_error_rate"] = \
+        section["arms"][0].pop("completed_no_error_rate")
+    with pytest.raises(BenchSchemaError, match=r"failover\.arms\[0\]"):
+        validate_result(_synthetic_with(section))

@@ -191,3 +191,26 @@ def test_disagg_section_contract_pinned():
     broken["arms"][1]["goodput"] = broken["arms"][1].pop("decode_goodput")
     with pytest.raises(BenchSchemaError, match=r"disagg\.arms\[1\]"):
         validate_result(dict(result, disagg=broken))
+
+
+def test_failover_section_contract_pinned():
+    """The failover section (docs/robustness.md) is validated
+    element-wise per arm: the synthetic section's keys ARE the schema's
+    failover/failover_arm sections, a rename inside an arm fails fast
+    with the arm's index, and failover: null (scenario off) stays
+    valid."""
+    from tools.preflight import synthetic_failover
+
+    schema = load_schema()
+    section = synthetic_failover()
+    assert set(section) == set(schema["failover"])
+    for arm in section["arms"]:
+        assert set(arm) == set(schema["failover_arm"])
+    result = synthetic_result()
+    validate_result(dict(result, failover=section))
+    validate_result(dict(result, failover=None))
+    broken = synthetic_failover()
+    broken["arms"][1]["no_error_rate"] = \
+        broken["arms"][1].pop("completed_no_error_rate")
+    with pytest.raises(BenchSchemaError, match=r"failover\.arms\[1\]"):
+        validate_result(dict(result, failover=broken))

@@ -808,9 +808,13 @@ def test_acceptance_affinity_fleet_warm_ttft_and_failover(fleet_engines):
                     for e in engines]
 
         # ---- affinity session: sticks to one replica, reuses pages
+        # resume_attempts=0: this test pins the CLASSIC mid-stream-loss
+        # contract (error frame, no failover) — test_failover.py covers
+        # the resume path.
         router_app = create_router_app(
             [(f"r{i}", u) for i, u in enumerate(urls)],
-            policy="affinity", heartbeat_s=0.3, run_heartbeat=True)
+            policy="affinity", heartbeat_s=0.3, run_heartbeat=True,
+            resume_attempts=0)
         client = TestClient(TestServer(router_app))
         await client.start_server()
         rows_aff: list = []

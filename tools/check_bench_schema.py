@@ -77,7 +77,7 @@ def validate_result(result: dict, schema: dict | None = None) -> None:
     _check_types("result", result, schema["top_level"], errors)
     for section in ("engine_pipeline", "engine_rounds", "e2e_ttft_dist_ms",
                     "chat", "openloop", "fleet", "capacity", "multichip",
-                    "kv_pressure", "autoscale", "disagg"):
+                    "kv_pressure", "autoscale", "disagg", "failover"):
         sub = result.get(section)
         if isinstance(sub, dict):
             _check_types(section, sub, schema[section], errors)
@@ -216,6 +216,21 @@ def validate_result(result: dict, schema: dict | None = None) -> None:
                 else:
                     errors.append(
                         f"disagg.arms[{i}]: {entry!r} is not an object")
+    # Failover scenario: each arm (resume on / resume off around the
+    # same scripted mid-stream kill) carries the error-free completion
+    # rate and the resume accounting — validated element-wise so a
+    # rename in one arm's dict can't hide behind the list type.
+    failover = result.get("failover")
+    if isinstance(failover, dict):
+        arms = failover.get("arms")
+        if isinstance(arms, list):
+            for i, entry in enumerate(arms):
+                if isinstance(entry, dict):
+                    _check_types(f"failover.arms[{i}]", entry,
+                                 schema["failover_arm"], errors)
+                else:
+                    errors.append(
+                        f"failover.arms[{i}]: {entry!r} is not an object")
     breakdown = result.get("e2e_breakdown_ms")
     if isinstance(breakdown, dict):
         allowed = set(schema["breakdown_stages"])

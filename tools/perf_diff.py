@@ -112,6 +112,14 @@ _MULTICHIP_FIELDS = {"decode_tokens_per_sec": ("tokens_per_sec",
 #: down AND decode goodput up — so both are gated round-over-round.
 _DISAGG_DIRECTIONS = {"ttft_p50_ms": "lower",
                       "decode_goodput": "higher"}
+#: Failover-scenario headlines, per arm (resume_on / resume_off around
+#: the same scripted mid-stream kill): the claim is the resume arm
+#: keeps the error-free completion rate at 1.0 without paying much
+#: added latency on the resumed streams — both gated round-over-round.
+#: (resumed_added_p50_ms is null on the resume_off arm and simply
+#: contributes nothing there.)
+_FAILOVER_DIRECTIONS = {"completed_no_error_rate": "higher",
+                        "resumed_added_p50_ms": "lower"}
 
 DEFAULT_THRESHOLD_PCT = 5.0
 
@@ -207,6 +215,18 @@ def extract_metrics(result: dict) -> dict[str, tuple[float, str]]:
                 v = _num(entry.get(key))
                 if v is not None:
                     out[f"disagg.{key}@{arm}"] = (v, direction)
+    failover = result.get("failover")
+    if isinstance(failover, dict):
+        for entry in failover.get("arms") or []:
+            if not isinstance(entry, dict):
+                continue
+            arm = entry.get("arm")
+            if not arm:
+                continue
+            for key, direction in _FAILOVER_DIRECTIONS.items():
+                v = _num(entry.get(key))
+                if v is not None:
+                    out[f"failover.{key}@{arm}"] = (v, direction)
     return out
 
 

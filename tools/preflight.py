@@ -327,6 +327,97 @@ def check_disagg() -> list[str]:
     return validate_disagg_block(synthetic_disagg())
 
 
+def synthetic_failover() -> dict:
+    """A fully-populated ``failover`` bench section (the BENCH_FAILOVER
+    scenario's output shape) — shared by the bench-schema synthetic
+    result and the failover check below; returned fresh so the tier-1
+    test can doctor a copy to prove the check fails."""
+    return {
+        "replicas": 3, "requests": 16, "rps": 3.0, "num_tokens": 32,
+        "arms": [
+            {"arm": "resume_on", "resume_attempts": 1,
+             "offered": 17, "completed": 17, "errors": 0,
+             "error_frames": 0, "completed_no_error_rate": 1.0,
+             "killed_replica": "r1", "resumes_ok": 2,
+             "resumes_failed": 0, "resume_replay_tokens": 18,
+             "resumed_p50_ms": 900.0, "unresumed_p50_ms": 620.0,
+             "resumed_added_p50_ms": 280.0, "ttft_p50_ms": 140.0,
+             "tokens_generated": 544},
+            {"arm": "resume_off", "resume_attempts": 0,
+             "offered": 17, "completed": 15, "errors": 2,
+             "error_frames": 2, "completed_no_error_rate": 0.8824,
+             "killed_replica": "r0", "resumes_ok": 0,
+             "resumes_failed": 2, "resume_replay_tokens": 0,
+             "resumed_p50_ms": None, "unresumed_p50_ms": 610.0,
+             "resumed_added_p50_ms": None, "ttft_p50_ms": 138.0,
+             "tokens_generated": 480},
+        ],
+    }
+
+
+def validate_failover_block(block: dict) -> list[str]:
+    """Element-wise + semantic validation of one ``failover`` section:
+    schema per arm, both arms present around the same scripted kill,
+    every completion rate an actual rate in [0, 1], the resume-on arm
+    having actually resumed something (zero resumes means the kill
+    never landed mid-stream — the arm measured nothing), and the
+    resume-off arm honoring its off switch."""
+    sys.path.insert(0, REPO)
+    from tools.check_bench_schema import (BenchSchemaError, load_schema,
+                                          validate_result)
+    errors: list[str] = []
+    try:
+        validate_result({"failover": block},
+                        schema={**load_schema(),
+                                "top_level": {"failover": ["obj"]}})
+    except BenchSchemaError as exc:
+        errors.append(str(exc))
+    arms = {a.get("arm"): a for a in (block.get("arms") or [])
+            if isinstance(a, dict)}
+    for want in ("resume_on", "resume_off"):
+        if want not in arms:
+            errors.append(f"arms: missing the {want!r} arm — the "
+                          f"comparison needs both around the same kill")
+    for name, arm in arms.items():
+        rate = arm.get("completed_no_error_rate")
+        if isinstance(rate, (int, float)) and not isinstance(rate, bool):
+            if not 0.0 <= rate <= 1.0:
+                errors.append(
+                    f"arms[{name}]: completed_no_error_rate {rate!r} "
+                    f"is not a rate in [0, 1]")
+        if isinstance(arm.get("completed"), int) and \
+                isinstance(arm.get("offered"), int) and \
+                arm["completed"] > arm["offered"]:
+            errors.append(
+                f"arms[{name}]: completed {arm['completed']} exceeds "
+                f"offered {arm['offered']}")
+    if len(arms) < 2:
+        return errors
+    on, off = arms.get("resume_on", {}), arms.get("resume_off", {})
+    if not on.get("resumes_ok", 0):
+        errors.append(
+            "arms[resume_on]: zero successful resumes — the scripted "
+            "kill never landed mid-stream; the arm measured an "
+            "uninterrupted fleet, not failover")
+    if off.get("resumes_ok", 0):
+        errors.append(
+            f"arms[resume_off]: {off['resumes_ok']} resumes with the "
+            f"budget at 0 — the off switch is not honored")
+    if on.get("resume_attempts", 0) < 1 or off.get("resume_attempts", 1):
+        errors.append(
+            "arms: resume_attempts must be >= 1 on the resume_on arm "
+            "and 0 on the resume_off arm")
+    return errors
+
+
+def check_failover() -> list[str]:
+    """Validate the failover scenario contract over the synthetic
+    section (schema + both-arms/rate-range/resume-accounting
+    invariants) — the same validator bench consumers can run over a
+    real BENCH_FAILOVER artifact."""
+    return validate_failover_block(synthetic_failover())
+
+
 def check_multichip() -> list[str]:
     """Validate the multichip sweep contract over the synthetic section
     (schema + mesh-label/device/budget/tail invariants) — the same
@@ -541,6 +632,7 @@ CHECKS: dict[str, Callable[[], list[str]]] = {
     "autoscale": check_autoscale,
     "multichip": check_multichip,
     "disagg": check_disagg,
+    "failover": check_failover,
     "perf-gates": check_perf_gates,
 }
 
