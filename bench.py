@@ -2116,6 +2116,76 @@ def rounds_snapshot(engine) -> dict:
     }
 
 
+def run_obs_overhead_bench(params, model_cfg, tokenizer, *,
+                           prompt_len: int, out_len: int,
+                           n_requests: int = 8, slots: int = 4,
+                           interval_s: float = 0.05,
+                           kv_quant: str = "", steps_per_round: int = 16,
+                           **engine_overrides):
+    """Observability-overhead scenario (``BENCH_OBS_OVERHEAD=1``): the
+    same closed-loop decode measurement twice — once with the
+    retained-telemetry layer DISARMED (``HISTORY_INTERVAL_S=0``
+    semantics: no sampler thread, no alert ticks) and once ARMED with
+    the history sampler at ``interval_s`` (far tighter than the 5 s
+    production default, to give the overhead a chance to show) plus the
+    full default chain-tier alert rule set ticking on every sample.
+    The acceptance bar in docs/observability.md: armed costs < 1 %
+    decode tok/s."""
+    from generativeaiexamples_tpu.engine import Engine, EngineConfig
+    from generativeaiexamples_tpu.obs import alerts as obs_alerts
+    from generativeaiexamples_tpu.obs import history as obs_history
+    from generativeaiexamples_tpu.obs import metrics as obs_metrics
+
+    page, per_slot = _sweep_pool_geometry(prompt_len, out_len,
+                                          engine_overrides)
+    kw = _sweep_engine_kw(slots, prompt_len, out_len, page, per_slot,
+                          kv_quant, steps_per_round, engine_overrides)
+    rules = obs_alerts.default_rules("chain")
+    arms = {}
+    armed_samples = 0
+    for armed in (False, True):
+        engine = Engine(params, model_cfg, tokenizer, EngineConfig(**kw))
+        history = None
+        try:
+            engine.prewarm()
+            # Same wiring the chain server's ObservabilityStack uses:
+            # engine stats mirrored into every sample, alert engine
+            # ticking as a sampler subscriber. The disarmed arm builds
+            # nothing at all — the HISTORY_INTERVAL_S=0 deployment.
+            if armed:
+                history = obs_history.MetricHistory(
+                    window_s=60.0, interval_s=interval_s,
+                    pre_sample=[lambda e=engine:
+                                obs_metrics.record_engine_stats(e.stats),
+                                obs_metrics.record_process_stats])
+                obs_alerts.AlertEngine(history, rules=rules).attach()
+                history.start()
+            _, _, tput, _ = run_engine_bench(
+                engine, prompt_len, out_len, n_requests, slots)
+            arms["armed" if armed else "disarmed"] = tput
+        finally:
+            if history is not None:
+                armed_samples = history.samples
+                history.stop()
+            engine.stop()
+        import gc
+        gc.collect()
+    armed_tps = arms.get("armed", 0.0)
+    disarmed_tps = arms.get("disarmed", 0.0)
+    overhead = ((disarmed_tps - armed_tps) / disarmed_tps * 100.0
+                if disarmed_tps > 0 else 0.0)
+    return {
+        "history_interval_s": interval_s,
+        "history_window_s": 60.0,
+        "alert_rules": len(rules),
+        "rounds_per_arm": n_requests,
+        "armed_tokens_per_sec": round(armed_tps, 1),
+        "disarmed_tokens_per_sec": round(disarmed_tps, 1),
+        "armed_samples": armed_samples,
+        "overhead_pct": round(overhead, 3),
+    }
+
+
 def assemble_result(*, kind, model, headline, engine_p50, engine_p99, tput,
                     achieved_bw, bw_util, bw_steady, chat, e2e_p50,
                     e2e_dist, e2e_breakdown, pipeline, quant, kv_quant,
@@ -2124,7 +2194,8 @@ def assemble_result(*, kind, model, headline, engine_p50, engine_p99, tput,
                     bench_seconds, e2e_tps_p50=None, openloop=None,
                     fleet=None, capacity=None, rounds=None,
                     kv_pressure=None, autoscale=None,
-                    multichip=None, disagg=None, failover=None) -> dict:
+                    multichip=None, disagg=None, failover=None,
+                    obs_overhead=None) -> dict:
     """The bench's single output contract. Every field name here is
     pinned by tools/bench_schema.json (validated at emit time AND by the
     tier-1 suite, tests/test_bench_schema.py) so a rename fails fast
@@ -2201,6 +2272,13 @@ def assemble_result(*, kind, model, headline, engine_p50, engine_p99, tput,
         # the latency resumed streams paid (docs/robustness.md). Null
         # when not requested.
         "failover": failover,
+        # Observability-overhead scenario (BENCH_OBS_OVERHEAD=1): the
+        # same decode workload with the retained-telemetry layer armed
+        # (history sampler + alert engine ticking) vs disarmed
+        # (HISTORY_INTERVAL_S=0) — decode tok/s each way and the
+        # percentage the armed layer costs (docs/observability.md).
+        # Null when not requested.
+        "obs_overhead": obs_overhead,
         "quantization": quant,
         "kv_quant": kv_quant,
         "weights": weights,
@@ -2764,6 +2842,28 @@ def main() -> None:
         except Exception as exc:  # noqa: BLE001
             sys.stderr.write(f"bench: failover scenario failed: {exc}\n")
 
+    # Observability-overhead scenario (BENCH_OBS_OVERHEAD=1): decode
+    # tok/s with the retained-telemetry layer armed vs disarmed
+    # (docs/observability.md's < 1 % acceptance bar). Fresh small
+    # engines over the measured params, main engine stopped. Degrades
+    # to null.
+    obs_overhead = None
+    if os.environ.get("BENCH_OBS_OVERHEAD", "") not in ("", "0"):
+        try:
+            obs_overhead = run_obs_overhead_bench(
+                engine.params, model_cfg, engine.tokenizer,
+                prompt_len=prompt_len, out_len=out_len,
+                n_requests=int(os.environ.get(
+                    "BENCH_OBS_REQUESTS", "8")),
+                slots=int(os.environ.get("BENCH_OBS_SLOTS", "4")),
+                interval_s=float(os.environ.get(
+                    "BENCH_OBS_INTERVAL_S", "0.05")),
+                kv_quant=engine.cfg.kv_quant,
+                steps_per_round=engine.cfg.steps_per_round)
+        except Exception as exc:  # noqa: BLE001
+            sys.stderr.write(f"bench: obs-overhead scenario failed: "
+                             f"{exc}\n")
+
     import jax
     # Headline = the full QA-chatbot path (BASELINE.json's north star is
     # the *chatbot* TTFT, not the engine-only number — VERDICT r3 weak
@@ -2779,7 +2879,7 @@ def main() -> None:
         pipeline=pipeline, openloop=openloop, fleet=fleet,
         capacity=capacity, rounds=rounds, kv_pressure=kv_pressure,
         autoscale=autoscale, multichip=multichip, disagg=disagg,
-        failover=failover,
+        failover=failover, obs_overhead=obs_overhead,
         quant=quant, kv_quant=engine.cfg.kv_quant or None,
         weights=("real" if os.environ.get("BENCH_MODEL_PATH")
                  else "random-init"),

@@ -64,8 +64,12 @@ from typing import Optional
 
 from aiohttp import web
 
+from ..obs import alerts as obs_alerts
 from ..obs import flight as obs_flight
+from ..obs import history as obs_history
+from ..obs import incidents as obs_incidents
 from ..obs import metrics as obs_metrics
+from ..obs import rounds as obs_rounds
 from ..obs.tracing import instrumented
 from ..serving.streaming import iterate_in_thread
 from ..utils import resilience
@@ -927,17 +931,22 @@ def create_app(example: BaseExample,
         return web.json_response(
             {"blocks": n, "pushed": pushed, "request_id": rid})
 
+    def _mirror_engine_stats() -> None:
+        engine = getattr(getattr(example, "llm", None), "engine", None)
+        if engine is not None:
+            obs_metrics.record_engine_stats(engine.stats)
+
     async def metrics_endpoint(request: web.Request) -> web.Response:
         # Scrape-time engine snapshot: when the example serves an
         # in-process engine (EngineLLM), surface its counters — decode
         # steps, prefills, prefix-cache hit tokens/rate/evictions — as
-        # engine_* gauges next to the chain-level request metrics.
-        engine = getattr(getattr(example, "llm", None), "engine", None)
-        if engine is not None:
-            try:
-                obs_metrics.record_engine_stats(engine.stats)
-            except Exception:  # noqa: BLE001 — metrics must never 500
-                logger.debug("engine stats unavailable", exc_info=True)
+        # engine_* gauges next to the chain-level request metrics, plus
+        # the process resource gauges (RSS/fds/threads).
+        try:
+            _mirror_engine_stats()
+        except Exception:  # noqa: BLE001 — metrics must never 500
+            logger.debug("engine stats unavailable", exc_info=True)
+        obs_metrics.record_process_stats()
         return web.Response(text=obs_metrics.REGISTRY.render_prometheus(),
                             content_type="text/plain")
 
@@ -950,13 +959,50 @@ def create_app(example: BaseExample,
         # Engine-level round telemetry: per-round plan + execution
         # records and rolling aggregates (obs/rounds.py; ?limit= caps
         # the record list).
-        from ..obs import rounds as obs_rounds
         return obs_rounds.debug_rounds_response(request)
+
+    # Retained telemetry (obs/history.py, obs/alerts.py,
+    # obs/incidents.py): the history ring samples the registry (engine
+    # stats + process gauges mirrored each tick), the alert engine ticks
+    # per sample, and firing rules freeze an incident bundle joining the
+    # history window with this server's flight/round rings. Inert as a
+    # unit when HISTORY_INTERVAL_S=0.
+    obs_stack = obs_incidents.ObservabilityStack(
+        "chain",
+        pre_sample=[_mirror_engine_stats, obs_metrics.record_process_stats],
+        flight=obs_flight.RECORDER, rounds=obs_rounds.RECORDER)
+
+    async def _obs_start(_app) -> None:
+        obs_stack.start()
+
+    async def _obs_stop(_app) -> None:
+        obs_stack.stop()
+
+    app.on_startup.append(_obs_start)
+    app.on_cleanup.append(_obs_stop)
+
+    async def debug_history(request: web.Request) -> web.Response:
+        return obs_history.debug_history_response(request,
+                                                  obs_stack.history)
+
+    async def debug_alerts(request: web.Request) -> web.Response:
+        return obs_alerts.debug_alerts_response(request, obs_stack.alerts)
+
+    async def debug_incidents(request: web.Request) -> web.Response:
+        return obs_incidents.debug_incidents_response(request, obs_stack)
+
+    async def control_incident(request: web.Request) -> web.Response:
+        return await obs_incidents.control_incident_response(request,
+                                                             obs_stack)
 
     app.router.add_get("/health", health)
     app.router.add_get("/metrics", metrics_endpoint)
     app.router.add_get("/debug/requests", debug_requests)
     app.router.add_get("/debug/rounds", debug_rounds)
+    app.router.add_get("/debug/history", debug_history)
+    app.router.add_get("/debug/alerts", debug_alerts)
+    app.router.add_get("/debug/incidents", debug_incidents)
+    app.router.add_post("/control/incident", control_incident)
     app.router.add_post("/uploadDocument", upload_document)
     app.router.add_post("/generate", generate_answer)
     app.router.add_post("/documentSearch", document_search)

@@ -229,7 +229,7 @@ def engine_stat_keys() -> tuple[str, ...]:
                "sched_prefill_share",
                "spec_acceptance_rate", "spec_tokens_per_step",
                "sched_cost_drift_ratio",
-               "kv_tier_host_pages", "kv_restore_hit_rate")
+               "kv_tier_host_pages", "kv_restore_hit_rate", "uptime_s")
             + tuple(CacheStats().snapshot()) + ("prefix_cache_pages",))
 
 
@@ -859,6 +859,10 @@ class Engine:
 
         self._stats_lock = threading.Lock()
         self._stats = dict(_STATS_TEMPLATE)  # keys doc-checked, see above
+        # Construction instant for the uptime_s stat — mirrored as the
+        # engine_uptime_s gauge so restarts are visible in /debug/history
+        # (a counter reset joins an uptime drop in the same sample).
+        self._created_monotonic = time.monotonic()
         self._stats["sched_round_budget_tokens"] = \
             self._sched.round_budget_tokens
         # Decode-attention page windows: power-of-two ladder up to the max.
@@ -1469,6 +1473,10 @@ class Engine:
         out["kv_restore_hit_rate"] = (
             round(out["kv_tier_restore_hits"] / lookups, 4)
             if lookups else 0.0)
+        # Engine age: mirrored as engine_uptime_s — the restart marker
+        # history/alert consumers join cumulative-counter resets against.
+        out["uptime_s"] = round(
+            time.monotonic() - self._created_monotonic, 3)
         return out
 
     def _bump(self, key: str, n: int = 1) -> None:

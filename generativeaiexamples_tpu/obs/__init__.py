@@ -6,6 +6,7 @@ opentelemetry_callback.py) plus the metrics registry the reference lacks
 (SURVEY.md §5: "No first-party metrics registry — a gap to fix").
 """
 
-from . import flight, metrics, rounds, tracing
+from . import alerts, flight, history, incidents, metrics, rounds, tracing
 
-__all__ = ["flight", "metrics", "rounds", "tracing"]
+__all__ = ["alerts", "flight", "history", "incidents", "metrics",
+           "rounds", "tracing"]

@@ -466,8 +466,7 @@ def debug_requests_response(request,
     chain server and the model server so the endpoint contract (``limit``
     parsing, error shape, snapshot schema) cannot drift between them."""
     from aiohttp import web
-    try:
-        limit = int(request.query.get("limit", "50"))
-    except ValueError:
-        raise web.HTTPBadRequest(text="limit must be an integer")
+
+    from .history import query_int
+    limit = query_int(request, "limit", 50, minimum=0)
     return web.json_response((recorder or RECORDER).snapshot(limit=limit))

@@ -27,6 +27,7 @@ round-7 torn-read fix, pinned by the observe-while-render stress test).
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Optional, Sequence
@@ -285,6 +286,14 @@ class Registry:
                     lines.append(f"{m.name}{_fmt_labels(pairs)} {leaf.value}")
         return "\n".join(lines) + "\n"
 
+    def kinds(self) -> dict[str, str]:
+        """Metric name -> kind ("counter"/"gauge"/"histogram") — how the
+        history sampler (obs/history.py) decides whether a snapshot key
+        aggregates as a level (gauge: last/min/max/avg) or as a
+        cumulative series (counter: delta + rate)."""
+        with self._lock:
+            return {name: m._kind for name, m in self._metrics.items()}
+
     def snapshot(self) -> dict[str, float]:
         """Flat name -> value map. Labeled children key as
         ``name{label="value"}`` (and ``name_count{...}``/``name_sum{...}``
@@ -460,6 +469,55 @@ def record_engine_stats(stats: dict, registry: Registry = REGISTRY,
                 f"derived per-event average of engine_{total_key} over "
                 f"engine_{count_key}").set(
                 float(stats[total_key]) / float(stats[count_key]))
+
+
+#: Process-level resource gauges published at scrape time next to the
+#: engine-stats mirror (and sampled into /debug/history) — the process
+#: memory/fd/thread signals the stack had no view of at all. Two-way
+#: doc-fenced in docs/observability.md via tools/check_metrics_docs.py.
+PROCESS_METRICS: tuple[tuple[str, str], ...] = (
+    ("process_rss_bytes", "resident set size of this server process "
+                          "(bytes, from /proc/self/status VmRSS)"),
+    ("process_open_fds", "open file descriptors held by this process"),
+    ("process_threads", "live threads in this process"),
+)
+
+
+def _read_proc_status() -> dict[str, float]:
+    out: dict[str, float] = {}
+    try:
+        with open("/proc/self/status", encoding="ascii",
+                  errors="ignore") as fh:
+            for line in fh:
+                if line.startswith("VmRSS:"):
+                    out["process_rss_bytes"] = \
+                        float(line.split()[1]) * 1024.0
+                elif line.startswith("Threads:"):
+                    out["process_threads"] = float(line.split()[1])
+    except OSError:
+        pass
+    return out
+
+
+def record_process_stats(registry: Registry = REGISTRY) -> None:
+    """Mirror process resource usage into the registry as gauges
+    (PROCESS_METRICS). Pull-at-scrape like ``record_engine_stats`` —
+    /metrics handlers and the history sampler call it; nothing on a
+    serving path does. Linux /proc only; on other platforms the gauges
+    fall back to what the stdlib can see (thread count) and 0."""
+    import threading as _threading
+
+    values = _read_proc_status()
+    values.setdefault("process_threads",
+                      float(_threading.active_count()))
+    try:
+        values["process_open_fds"] = float(len(os.listdir("/proc/self/fd")))
+    except OSError:
+        values.setdefault("process_open_fds", 0.0)
+    values.setdefault("process_rss_bytes", 0.0)
+    help_by_name = dict(PROCESS_METRICS)
+    for name, _ in PROCESS_METRICS:
+        registry.gauge(name, help_by_name[name]).set(values[name])
 
 
 class RequestTimer:

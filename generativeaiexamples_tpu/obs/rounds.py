@@ -510,10 +510,9 @@ def debug_rounds_response(request,
     drift between them. ``?engine=<tag>`` scopes records and aggregates
     to one engine in multi-engine processes."""
     from aiohttp import web
-    try:
-        limit = int(request.query.get("limit", "50"))
-    except ValueError:
-        raise web.HTTPBadRequest(text="limit must be an integer")
+
+    from .history import query_int
+    limit = query_int(request, "limit", 50, minimum=0)
     engine_tag = request.query.get("engine") or None
     return web.json_response((recorder or RECORDER).snapshot(
         limit=limit, engine_tag=engine_tag))

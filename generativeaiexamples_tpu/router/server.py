@@ -95,7 +95,11 @@ from typing import Optional, Sequence
 import aiohttp
 from aiohttp import web
 
+from ..obs import alerts as obs_alerts
 from ..obs import flight as obs_flight
+from ..obs import history as obs_history
+from ..obs import incidents as obs_incidents
+from ..obs import metrics as obs_metrics
 from ..utils import faults
 from ..utils.logging import get_logger
 from . import autoscale as router_autoscale
@@ -1130,11 +1134,11 @@ def create_router_app(replicas: Sequence[tuple[str, str]] = (), *,
             status=200 if healthy else 503)
 
     async def metrics_endpoint(request: web.Request) -> web.Response:
-        from ..obs import metrics as obs_metrics
         # Scrape-time refresh: heartbeat ages recompute from the live
         # table, so a STALLED poller reads as a growing age — a frozen
         # gauge would hide exactly the failure it exists to show.
         table.publish_heartbeat_ages()
+        obs_metrics.record_process_stats()
         return web.Response(text=obs_metrics.REGISTRY.render_prometheus(),
                             content_type="text/plain")
 
@@ -1202,10 +1206,7 @@ def create_router_app(replicas: Sequence[tuple[str, str]] = (), *,
         if router.autoscale is None:
             return web.json_response(
                 {"enabled": False, "surge": router.surge.snapshot()})
-        try:
-            limit = int(request.query.get("limit", "50") or 50)
-        except ValueError:
-            raise web.HTTPBadRequest(text="limit must be an integer")
+        limit = obs_history.query_int(request, "limit", 50, minimum=0)
         return web.json_response(router.autoscale.snapshot(limit=limit))
 
     async def control_autoscale(request: web.Request) -> web.Response:
@@ -1234,23 +1235,104 @@ def create_router_app(replicas: Sequence[tuple[str, str]] = (), *,
     async def forward(request: web.Request) -> web.StreamResponse:
         return await router.forward(request)
 
+    # Retained telemetry (docs/observability.md): the router's history
+    # ring samples the fleet gauges the heartbeat publishes (ages
+    # refreshed per sample, same as per scrape), the alert engine runs
+    # the FLEET rule set (SLO burn rate, heartbeat staleness), and
+    # incident capture is ASYNC — the sampler thread fires, a loop
+    # coroutine gathers each replica's /debug/requests + /debug/rounds
+    # slice alongside the local evidence, then the bundle write runs
+    # off-loop. Inert as a unit when HISTORY_INTERVAL_S=0.
+    _obs_loop: dict = {}
+
+    async def _capture_with_fleet(trigger: dict) -> None:
+        limit = obs_incidents.INCIDENT_SLICE_LIMIT
+        extras: dict = {"fleet": None, "autoscale": None, "replicas": {}}
+        try:
+            extras["fleet"] = router.refresh_fleet()
+        except Exception:  # noqa: BLE001 — evidence is best-effort
+            logger.debug("incident fleet snapshot failed", exc_info=True)
+        if router.autoscale is not None:
+            try:
+                extras["autoscale"] = router.autoscale.snapshot(
+                    limit=limit)
+            except Exception:  # noqa: BLE001
+                logger.debug("incident autoscale snapshot failed",
+                             exc_info=True)
+        session = router._session
+        if session is not None:
+            for rep in table.replicas():
+                row: dict = {}
+                for ep in ("requests", "rounds"):
+                    try:
+                        async with session.get(
+                                f"{rep.url}/debug/{ep}?limit={limit}",
+                                timeout=aiohttp.ClientTimeout(
+                                    total=router.heartbeat_timeout_s)
+                                ) as resp:
+                            row[ep] = await resp.json()
+                    except Exception:  # noqa: BLE001 — replica may be
+                        row[ep] = None  # the incident; keep the rest
+                extras["replicas"][rep.name] = row
+        await asyncio.get_running_loop().run_in_executor(
+            None, functools.partial(obs_stack.capture, trigger, extras))
+
+    def _capture_async(rule, trigger: dict) -> None:
+        loop = _obs_loop.get("loop")
+        if loop is None or loop.is_closed():
+            # No running app loop (tests driving tick() by hand):
+            # capture the local evidence, skip the replica pulls.
+            obs_stack.capture(trigger)
+            return
+        asyncio.run_coroutine_threadsafe(_capture_with_fleet(trigger),
+                                         loop)
+
+    obs_stack = obs_incidents.ObservabilityStack(
+        "router",
+        pre_sample=[table.publish_heartbeat_ages,
+                    obs_metrics.record_process_stats],
+        flight=router.flight,
+        capture_async=_capture_async)
+
+    async def debug_history(request: web.Request) -> web.Response:
+        return obs_history.debug_history_response(request,
+                                                  obs_stack.history)
+
+    async def debug_alerts(request: web.Request) -> web.Response:
+        return obs_alerts.debug_alerts_response(request, obs_stack.alerts)
+
+    async def debug_incidents(request: web.Request) -> web.Response:
+        return obs_incidents.debug_incidents_response(request, obs_stack)
+
+    async def control_incident(request: web.Request) -> web.Response:
+        return await obs_incidents.control_incident_response(request,
+                                                             obs_stack)
+
     app.router.add_get("/health", health)
     app.router.add_get("/metrics", metrics_endpoint)
     app.router.add_get("/debug/requests", debug_requests)
     app.router.add_get("/debug/fleet", debug_fleet)
     app.router.add_get("/debug/autoscale", debug_autoscale)
+    app.router.add_get("/debug/history", debug_history)
+    app.router.add_get("/debug/alerts", debug_alerts)
+    app.router.add_get("/debug/incidents", debug_incidents)
     app.router.add_get("/router/replicas", list_replicas)
     app.router.add_post("/control/replicas", control_replicas)
     app.router.add_post("/control/heartbeat", control_heartbeat)
     app.router.add_post("/control/autoscale", control_autoscale)
+    app.router.add_post("/control/incident", control_incident)
     for path in FORWARD_PATHS:
         app.router.add_post(path, forward)
 
     async def on_startup(app_: web.Application) -> None:
+        _obs_loop["loop"] = asyncio.get_running_loop()
         await router.start(run_heartbeat=run_heartbeat,
                            run_autoscale=run_autoscale)
+        obs_stack.start()
 
     async def on_cleanup(app_: web.Application) -> None:
+        obs_stack.stop()
+        _obs_loop.pop("loop", None)
         await router.stop()
 
     app.on_startup.append(on_startup)

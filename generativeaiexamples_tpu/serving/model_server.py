@@ -297,16 +297,20 @@ def create_server_app(engine, embed_service=None,
             {"status": "ok", "model": model_name,
              "engine": dict(engine.stats)})
 
+    def _mirror_engine_stats() -> None:
+        obs_metrics.record_engine_stats(engine.stats)
+
     async def metrics_endpoint(request: web.Request) -> web.Response:
         # Scrape-time engine snapshot (same contract as the chain
         # server's /metrics): every numeric Engine.stats() key mirrors
         # as an engine_* gauge, so both server surfaces expose the
         # doc-checked gauge table — including the round-telemetry and
-        # cost-drift counters.
+        # cost-drift counters — plus the process resource gauges.
         try:
-            obs_metrics.record_engine_stats(engine.stats)
+            _mirror_engine_stats()
         except Exception:  # noqa: BLE001 — metrics must never 500
             logger.debug("engine stats unavailable", exc_info=True)
+        obs_metrics.record_process_stats()
         return web.Response(text=obs_metrics.REGISTRY.render_prometheus(),
                             content_type="text/plain")
 
@@ -325,6 +329,44 @@ def create_server_app(engine, embed_service=None,
         from ..obs import rounds as obs_rounds
         return obs_rounds.debug_rounds_response(
             request, getattr(engine, "rounds", None))
+
+    # Retained telemetry: history ring + alert engine + incident
+    # black-box, same wiring as the chain server (one unit, inert when
+    # HISTORY_INTERVAL_S=0). Engine stats and process gauges are
+    # mirrored into every history sample so alerts see them between
+    # scrapes.
+    from ..obs import alerts as obs_alerts
+    from ..obs import history as obs_history
+    from ..obs import incidents as obs_incidents
+
+    obs_stack = obs_incidents.ObservabilityStack(
+        "model",
+        pre_sample=[_mirror_engine_stats,
+                    obs_metrics.record_process_stats],
+        flight=engine.flight, rounds=engine.rounds)
+
+    async def _obs_start(_app) -> None:
+        obs_stack.start()
+
+    async def _obs_stop(_app) -> None:
+        obs_stack.stop()
+
+    app.on_startup.append(_obs_start)
+    app.on_cleanup.append(_obs_stop)
+
+    async def debug_history(request: web.Request) -> web.Response:
+        return obs_history.debug_history_response(request,
+                                                  obs_stack.history)
+
+    async def debug_alerts(request: web.Request) -> web.Response:
+        return obs_alerts.debug_alerts_response(request, obs_stack.alerts)
+
+    async def debug_incidents(request: web.Request) -> web.Response:
+        return obs_incidents.debug_incidents_response(request, obs_stack)
+
+    async def control_incident(request: web.Request) -> web.Response:
+        return await obs_incidents.control_incident_response(request,
+                                                             obs_stack)
 
     # On-demand device profiling (SURVEY §5: the jax.profiler endpoint on
     # the serving engine — the role nsys would play on the reference's
@@ -491,6 +533,10 @@ def create_server_app(engine, embed_service=None,
     app.router.add_get("/metrics", metrics_endpoint)
     app.router.add_get("/debug/requests", debug_requests)
     app.router.add_get("/debug/rounds", debug_rounds)
+    app.router.add_get("/debug/history", debug_history)
+    app.router.add_get("/debug/alerts", debug_alerts)
+    app.router.add_get("/debug/incidents", debug_incidents)
+    app.router.add_post("/control/incident", control_incident)
     app.router.add_post("/v1/score", score)
     app.router.add_post("/profiler/start", profiler_start)
     app.router.add_post("/profiler/stop", profiler_stop)

@@ -1,0 +1,492 @@
+"""Retained-telemetry tests: MetricHistory ring semantics and windowed
+aggregation, the shared /debug query parser's 400 contract, the
+AlertEngine state machine (pending→firing→resolved, exactly-once
+on_fire), the count/byte-capped IncidentStore, the incident bundle +
+markdown report join, and the HISTORY_INTERVAL_S=0 inertness pin across
+the whole stack (no sampler thread, no alert engine, no disk writes)."""
+
+import asyncio
+import json
+import os
+import threading
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from generativeaiexamples_tpu.obs import history as obs_history
+from generativeaiexamples_tpu.obs import incidents as obs_incidents
+from generativeaiexamples_tpu.obs.alerts import (AlertEngine, AlertRule,
+                                                 default_rules)
+from generativeaiexamples_tpu.obs.history import MetricHistory
+from generativeaiexamples_tpu.obs.incidents import (IncidentStore,
+                                                    ObservabilityStack,
+                                                    build_bundle)
+from generativeaiexamples_tpu.obs.metrics import Registry
+
+
+def _history(registry, window_s=60.0, interval_s=0.01, **kw):
+    return MetricHistory(registry=registry, window_s=window_s,
+                         interval_s=interval_s, **kw)
+
+
+# ------------------------------------------------------------ history ring
+
+
+def test_history_aggregates_gauge_and_reset_aware_counter_delta():
+    reg = Registry()
+    g = reg.gauge("g_load", "")
+    c = reg.counter("c_events", "")
+    hist = _history(reg)
+    for v in (1.0, 3.0, 2.0):
+        g.set(v)
+        c.inc(2.0)
+        hist.sample_once()
+    q = hist.query()
+    assert q["enabled"] and q["samples"] == 3
+    gl = q["series"]["g_load"]
+    assert gl["kind"] == "gauge"
+    assert (gl["last"], gl["min"], gl["max"]) == (2.0, 1.0, 3.0)
+    assert gl["avg"] == pytest.approx(2.0)
+    assert "delta" not in gl                   # gauges don't get deltas
+    ce = q["series"]["c_events"]
+    assert ce["kind"] == "counter"
+    assert ce["delta"] == pytest.approx(4.0)   # forward movement only
+    assert ce["rate_per_s"] >= 0.0
+    # a process restart drops the cumulative value mid-window: the
+    # reset-aware delta must not go negative or swallow later increments
+    c._value = 0.0                             # simulate restart
+    c.inc(1.0)
+    hist.sample_once()                         # backwards step clamps to 0
+    assert hist.query()["series"]["c_events"]["delta"] == pytest.approx(4.0)
+    c.inc(3.0)
+    hist.sample_once()                         # post-reset growth counts
+    assert hist.query()["series"]["c_events"]["delta"] == pytest.approx(7.0)
+
+
+def test_history_glob_filter_matches_base_name_and_labeled_keys():
+    reg = Registry()
+    reg.gauge("router_slo_attainment", "", labelnames=("replica",)) \
+        .labels("r0").set(0.5)
+    reg.gauge("other_gauge", "").set(1.0)
+    hist = _history(reg)
+    hist.sample_once()
+    keys = set(hist.query(metrics="router_slo*")["series"])
+    assert keys == {'router_slo_attainment{replica="r0"}'}
+    assert set(hist.query()["series"]) >= {"other_gauge"}
+
+
+def test_history_ring_bounded_by_window():
+    reg = Registry()
+    reg.gauge("g", "").set(1.0)
+    hist = _history(reg, window_s=1.0, interval_s=0.25)
+    cap = hist._ring.maxlen
+    assert cap == int(1.0 / 0.25) + 1
+    for _ in range(cap * 3):
+        hist.sample_once()
+    assert hist.samples == cap
+
+
+def test_history_inert_when_interval_zero_no_thread_no_samples():
+    hist = _history(Registry(), interval_s=0.0)
+    hist.start()                               # must be a no-op
+    assert not hist.enabled
+    assert hist._thread is None                # no sampler thread spawned
+    q = hist.query()
+    assert q == {"enabled": False, "interval_s": 0.0, "window_s": 60.0,
+                 "samples": 0, "span_s": 0.0, "series": {}}
+
+
+def test_history_sampler_thread_ticks_and_stops():
+    reg = Registry()
+    reg.gauge("g", "").set(7.0)
+    hist = _history(reg, interval_s=0.01)
+    ticks = []
+    hist.on_sample.append(lambda h: ticks.append(h.samples))
+    hist.start()
+    thread = hist._thread
+    assert thread is not None and thread.name == "metric-history"
+    deadline = 100
+    while hist.samples < 3 and deadline:
+        deadline -= 1
+        threading.Event().wait(0.02)
+    hist.stop()
+    assert hist.samples >= 3 and ticks
+    assert not thread.is_alive()               # stop() joined OUR thread
+
+
+# ------------------------------------------------------- alert state machine
+
+
+def _stall_rule(**kw):
+    base = dict(window_s=30.0, for_s=0.0, severity="critical")
+    base.update(kw)
+    return AlertRule("stall", "engine_watchdog_stalls", "delta", ">",
+                     0.0, **base)
+
+
+def test_alert_fires_once_per_episode_and_resolves():
+    reg = Registry()
+    g = reg.gauge("engine_watchdog_stalls", "")
+    g.set(0.0)
+    hist = _history(reg)
+    fired = []
+    eng = AlertEngine(hist, rules=(_stall_rule(),), registry=reg,
+                      on_fire=lambda r, rec: fired.append(rec)).attach()
+    hist.sample_once()                         # flat baseline
+    assert eng._states["stall"].state == "ok"
+    g.set(1.0)                                 # the breach
+    hist.sample_once()
+    assert eng._states["stall"].state == "firing"
+    assert len(fired) == 1
+    assert fired[0]["evidence"]["series"]["engine_watchdog_stalls"][
+        "value"] > 0
+    hist.sample_once()                         # stays firing: no re-fire
+    hist.sample_once()
+    assert len(fired) == 1
+    assert reg.snapshot()['alerts_firing{rule="stall"}'] == 1.0
+    # flat again long enough that the delta leaves the window: use a
+    # tiny window engine to avoid sleeping
+    eng2_hist = _history(reg, window_s=0.01)
+    import time
+    eng2 = AlertEngine(eng2_hist, rules=(_stall_rule(window_s=0.05),),
+                       registry=reg, on_fire=lambda r, rec: None)
+    eng2_hist.sample_once()
+    time.sleep(0.08)
+    eng2_hist.sample_once()
+    eng2.tick()
+    assert eng2._states["stall"].state == "ok"
+
+
+def test_alert_for_duration_debounce_pending_then_firing():
+    reg = Registry()
+    g = reg.gauge("engine_watchdog_stalls", "")
+    g.set(0.0)
+    hist = _history(reg)
+    fired = []
+    eng = AlertEngine(hist, rules=(_stall_rule(for_s=3600.0),),
+                      registry=reg,
+                      on_fire=lambda r, rec: fired.append(rec))
+    hist.sample_once()
+    g.set(1.0)
+    hist.sample_once()
+    eng.tick(now=1000.0)
+    assert eng._states["stall"].state == "pending" and not fired
+    eng.tick(now=1000.0 + 10.0)                # still inside for_s
+    assert eng._states["stall"].state == "pending" and not fired
+    eng.tick(now=1000.0 + 3601.0)              # debounce satisfied
+    assert eng._states["stall"].state == "firing"
+    assert len(fired) == 1
+    assert eng._states["stall"].episodes == 1
+
+
+def test_alert_refire_after_resolve_is_a_new_episode():
+    reg = Registry()
+    g = reg.gauge("engine_watchdog_stalls", "")
+    g.set(0.0)
+    hist = _history(reg, window_s=0.2)
+    fired = []
+    eng = AlertEngine(hist, rules=(_stall_rule(window_s=0.2),),
+                      registry=reg,
+                      on_fire=lambda r, rec: fired.append(rec))
+    import time
+    hist.sample_once()
+    g.set(1.0)
+    hist.sample_once()
+    eng.tick()
+    assert eng._states["stall"].state == "firing"
+    time.sleep(0.25)                           # breach ages out
+    hist.sample_once()
+    eng.tick()
+    assert eng._states["stall"].state == "ok"
+    g.set(2.0)                                 # second stall
+    hist.sample_once()
+    eng.tick()
+    assert eng._states["stall"].state == "firing"
+    assert len(fired) == 2
+    assert eng._states["stall"].episodes == 2
+    snap = reg.snapshot()
+    assert snap['alerts_total{rule="stall",state="firing"}'] == 2.0
+    assert snap['alerts_total{rule="stall",state="resolved"}'] == 1.0
+
+
+def test_alert_snapshot_shape_and_firing_headline():
+    reg = Registry()
+    g = reg.gauge("engine_watchdog_stalls", "")
+    g.set(0.0)
+    hist = _history(reg)
+    eng = AlertEngine(hist, rules=(_stall_rule(),), registry=reg)
+    hist.sample_once()
+    g.set(1.0)
+    hist.sample_once()
+    eng.tick()
+    snap = eng.snapshot()
+    assert snap["enabled"] and snap["firing"] == ["stall"]
+    row = next(r for r in snap["rules"] if r["rule"] == "stall")
+    assert row["state"] == "firing" and row["severity"] == "critical"
+    assert row["evidence"]["series"]
+
+
+def test_default_rules_per_tier_and_env_thresholds(monkeypatch):
+    monkeypatch.setenv("ALERT_DRIFT_RATIO_MAX", "9.5")
+    chain = {r.name: r for r in default_rules("chain")}
+    router = {r.name: r for r in default_rules("router")}
+    assert {"engine_watchdog_stall", "kv_restore_corrupt",
+            "sched_cost_drift", "breaker_flap",
+            "shed_rate"} == set(chain)
+    assert {"slo_burn_rate", "heartbeat_stale", "breaker_flap",
+            "shed_rate"} == set(router)
+    assert chain["sched_cost_drift"].threshold == 9.5
+    with pytest.raises(ValueError):
+        AlertRule("bad", "m", "median", ">", 0.0)
+    with pytest.raises(ValueError):
+        AlertRule("bad", "m", "avg", "~", 0.0)
+
+
+# --------------------------------------------------------- incident store
+
+
+def _bundle(i, pad=0):
+    return {"schema": "incident/v1", "server": "chain",
+            "ts": 1000.0 + i,
+            "trigger": {"kind": "alert", "rule": "stall",
+                        "evidence": {"series": {"m": {"value": 1.0}}}},
+            "alerts": None,
+            "history": {"aggregates": {"series": {}}, "window": []},
+            "flight": None, "rounds": None, "pad": "x" * pad}
+
+
+def test_incident_store_capture_list_load_roundtrip(tmp_path):
+    store = IncidentStore(root=str(tmp_path / "inc"), max_count=10,
+                          max_bytes=1 << 20)
+    path = store.capture(_bundle(0))
+    assert path and os.path.exists(path)
+    listing = store.list()
+    assert listing["count"] == 1
+    entry = listing["incidents"][0]
+    assert entry["rule"] == "stall" and entry["kind"] == "alert"
+    loaded = store.load(entry["id"])
+    assert loaded["schema"] == "incident/v1"
+    assert store.load("no-such-incident") is None
+
+
+def test_incident_store_count_cap_evicts_oldest(tmp_path):
+    store = IncidentStore(root=str(tmp_path / "inc"), max_count=3,
+                          max_bytes=1 << 20)
+    paths = [store.capture(_bundle(i)) for i in range(5)]
+    names = sorted(os.listdir(store.root))
+    assert len(names) == 3
+    # the two oldest were evicted
+    assert os.path.basename(paths[0]) not in names
+    assert os.path.basename(paths[1]) not in names
+    assert store.list()["count"] == 3
+
+
+def test_incident_store_byte_cap_evicts_oldest(tmp_path):
+    store = IncidentStore(root=str(tmp_path / "inc"), max_count=100,
+                          max_bytes=6000)
+    for i in range(4):
+        store.capture(_bundle(i, pad=2000))    # each bundle > 2 KB
+    listing = store.list()
+    assert listing["total_bytes"] <= 6000
+    assert listing["count"] < 4
+
+
+def test_incident_store_path_traversal_guarded(tmp_path):
+    secret = tmp_path / "secret.json"
+    secret.write_text("{}")
+    store = IncidentStore(root=str(tmp_path / "inc"))
+    store.capture(_bundle(0))
+    assert store.load("../secret") is None
+
+
+def test_build_bundle_joins_history_flight_rounds_and_extras():
+    from generativeaiexamples_tpu.obs.flight import FlightRecorder
+
+    reg = Registry()
+    reg.gauge("g", "").set(1.0)
+    hist = _history(reg)
+    hist.sample_once()
+    flight = FlightRecorder(completed_cap=8)
+    flight.complete(flight.begin("req-1"))
+    bundle = build_bundle(server="router",
+                          trigger={"kind": "manual", "rule": None},
+                          history=hist, alerts=None, flight=flight,
+                          rounds=None, extras={"fleet": {"replicas": 2}})
+    assert bundle["schema"] == "incident/v1"
+    assert bundle["server"] == "router"
+    assert bundle["history"]["window"]
+    assert bundle["history"]["aggregates"]["series"]["g"]["last"] == 1.0
+    assert [t["request_id"] for t in bundle["flight"]["completed"]] \
+        == ["req-1"]
+    assert bundle["fleet"] == {"replicas": 2}
+    assert json.dumps(bundle)                  # JSON-serializable
+
+
+def test_incident_report_renders_markdown_with_request_join(tmp_path):
+    from tools.incident_report import render_markdown
+
+    from generativeaiexamples_tpu.obs.flight import FlightRecorder
+
+    reg = Registry()
+    reg.gauge("engine_watchdog_stalls", "").set(1.0)
+    hist = _history(reg)
+    hist.sample_once()
+    flight = FlightRecorder(completed_cap=8)
+    flight.complete(flight.begin("joined-req-9"))
+    bundle = build_bundle(
+        server="chain",
+        trigger={"kind": "alert", "rule": "engine_watchdog_stall",
+                 "severity": "critical", "summary": "stalled",
+                 "evidence": {"series": {"engine_watchdog_stalls":
+                                         {"value": 1.0}}}},
+        history=hist, alerts=None, flight=flight, rounds=None)
+    bundle["id"] = "inc-test-1"
+    report = render_markdown(bundle)
+    assert "engine_watchdog_stall" in report
+    assert "joined-req-9" in report
+    assert "inc-test-1" in report
+
+
+# ------------------------------------------------- stack inertness + HTTP
+
+
+def test_stack_inert_when_interval_zero_no_alerts_no_store(tmp_path,
+                                                           monkeypatch):
+    monkeypatch.setenv("GAIE_RUN_DIR", str(tmp_path / "run"))
+    stack = ObservabilityStack("chain", registry=Registry(),
+                               interval_s=0.0)
+    stack.start()
+    assert not stack.enabled
+    assert stack.alerts is None and stack.store is None
+    assert stack.capture({"kind": "manual"}) is None
+    assert not (tmp_path / "run").exists()     # zero disk writes
+    assert stack.history._thread is None
+
+
+def test_stack_armed_capture_writes_bundle_with_extras(tmp_path,
+                                                       monkeypatch):
+    monkeypatch.setenv("GAIE_RUN_DIR", str(tmp_path / "run"))
+    stack = ObservabilityStack(
+        "chain", registry=Registry(), interval_s=0.01,
+        capture_extras=lambda: {"fleet": {"n": 1}})
+    stack.history.sample_once()
+    path = stack.capture({"kind": "manual", "rule": None})
+    assert path and path.startswith(str(tmp_path / "run"))
+    with open(path, encoding="utf-8") as fh:
+        bundle = json.load(fh)
+    assert bundle["fleet"] == {"n": 1}
+    assert bundle["alerts"]["enabled"]         # alert engine attached
+
+
+def _run(coro):
+    return asyncio.get_event_loop_policy().new_event_loop() \
+        .run_until_complete(coro)
+
+
+from generativeaiexamples_tpu.chains.base import BaseExample
+
+
+class _EchoExample(BaseExample):
+    """Minimal example for endpoint tests."""
+
+    def llm_chain(self, context, question, num_tokens):
+        yield "ok"
+
+    def rag_chain(self, prompt, num_tokens):
+        yield "ok"
+
+    def ingest_docs(self, data_dir, filename):
+        pass
+
+
+def test_chain_server_debug_endpoints_armed(tmp_path, monkeypatch):
+    from generativeaiexamples_tpu.chains.server import create_app
+
+    monkeypatch.setenv("GAIE_RUN_DIR", str(tmp_path / "run"))
+    monkeypatch.setattr(obs_history, "HISTORY_INTERVAL_S", 0.02)
+
+    async def fn():
+        client = TestClient(TestServer(create_app(_EchoExample())))
+        await client.start_server()
+        try:
+            # the sampler thread populates history shortly after startup
+            for _ in range(100):
+                hist = await (await client.get("/debug/history")).json()
+                if hist["enabled"] and hist["samples"] >= 2:
+                    break
+                await asyncio.sleep(0.02)
+            assert hist["samples"] >= 2 and hist["series"]
+            # glob filtering via the query param
+            filtered = await (await client.get(
+                "/debug/history?metrics=engine_*")).json()
+            assert all(k.startswith("engine_")
+                       for k in filtered["series"])
+
+            alerts = await (await client.get("/debug/alerts")).json()
+            assert alerts["enabled"] and alerts["server"] == "chain"
+            assert {r["rule"] for r in alerts["rules"]} \
+                == {r.name for r in default_rules("chain")}
+            assert alerts["ticks"] >= 1        # attached to the sampler
+
+            # uniform query validation: 400 JSON body + X-Request-ID
+            resp = await client.get("/debug/history?window=bogus",
+                                    headers={"X-Request-ID": "q-1"})
+            assert resp.status == 400
+            assert resp.headers["X-Request-ID"] == "q-1"
+            body = await resp.json()
+            assert body["error"]["type"] == "bad_query"
+            assert body["request_id"] == "q-1"
+            assert (await client.get(
+                "/debug/incidents?limit=-2")).status == 400
+
+            # manual black-box capture -> listed -> loadable by id
+            resp = await client.post("/control/incident",
+                                     json={"reason": "drill"})
+            assert resp.status == 200
+            captured = await resp.json()
+            assert captured["status"] == "captured"
+            listing = await (await client.get("/debug/incidents")).json()
+            assert listing["enabled"] and listing["count"] == 1
+            assert listing["incidents"][0]["id"] == captured["id"]
+            bundle = await (await client.get(
+                f"/debug/incidents?id={captured['id']}")).json()
+            assert bundle["schema"] == "incident/v1"
+            assert bundle["trigger"]["kind"] == "manual"
+            assert bundle["trigger"]["reason"] == "drill"
+            assert (await client.get(
+                "/debug/incidents?id=nope")).status == 404
+        finally:
+            await client.close()
+
+    _run(fn())
+
+
+def test_chain_server_debug_endpoints_inert(tmp_path, monkeypatch):
+    from generativeaiexamples_tpu.chains.server import create_app
+
+    monkeypatch.setenv("GAIE_RUN_DIR", str(tmp_path / "run"))
+    monkeypatch.setattr(obs_history, "HISTORY_INTERVAL_S", 0.0)
+
+    async def fn():
+        before = {t.name for t in threading.enumerate()}
+        client = TestClient(TestServer(create_app(_EchoExample())))
+        await client.start_server()
+        try:
+            assert "metric-history" not in \
+                {t.name for t in threading.enumerate()} - before
+            hist = await (await client.get("/debug/history")).json()
+            assert hist == {**hist, "enabled": False, "samples": 0}
+            alerts = await (await client.get("/debug/alerts")).json()
+            assert alerts["enabled"] is False and alerts["firing"] == []
+            listing = await (await client.get("/debug/incidents")).json()
+            assert listing == {"enabled": False, "count": 0,
+                               "incidents": []}
+            resp = await client.post("/control/incident", json={})
+            assert resp.status == 409
+            body = await resp.json()
+            assert body["error"]["type"] == "incidents_disabled"
+            assert not (tmp_path / "run").exists()
+        finally:
+            await client.close()
+
+    _run(fn())

@@ -85,6 +85,87 @@ def test_disagg_check_detects_failure_classes():
                for e in preflight.validate_disagg_block(block))
 
 
+def test_obs_overhead_check_detects_failure_classes():
+    """Green on the synthetic section, and each broken-artifact class
+    actually fails — an overhead gate that can't fail would let the
+    armed arm silently measure a disarmed stack twice."""
+    assert preflight.validate_obs_overhead_block(
+        preflight.synthetic_obs_overhead()) == []
+    # the sampler never ran in the armed arm
+    block = preflight.synthetic_obs_overhead()
+    block["armed_samples"] = 0
+    assert any("sampler never ran" in e
+               for e in preflight.validate_obs_overhead_block(block))
+    # the "armed" arm was actually disarmed
+    block = preflight.synthetic_obs_overhead()
+    block["history_interval_s"] = 0.0
+    assert any("disarmed" in e
+               for e in preflight.validate_obs_overhead_block(block))
+    # headline number inconsistent with the arms it claims to compare
+    block = preflight.synthetic_obs_overhead()
+    block["overhead_pct"] = 40.0
+    assert any("does not match the arms" in e
+               for e in preflight.validate_obs_overhead_block(block))
+    # an unmeasured arm
+    block = preflight.synthetic_obs_overhead()
+    block["disarmed_tokens_per_sec"] = 0.0
+    assert any("positive rate" in e
+               for e in preflight.validate_obs_overhead_block(block))
+    # schema drift (field rename) caught by the element-wise pass
+    block = preflight.synthetic_obs_overhead()
+    block["tokens_per_sec_armed"] = block.pop("armed_tokens_per_sec")
+    assert preflight.validate_obs_overhead_block(block)
+
+
+def test_incident_bundle_validator_detects_failure_classes():
+    """The synthetic bundle (built through the real history → alert →
+    build_bundle pipeline) is green; each contract violation fails."""
+    assert preflight.validate_incident_bundle(
+        preflight.synthetic_incident_bundle()) == []
+    # wrong schema tag
+    bundle = preflight.synthetic_incident_bundle()
+    bundle["schema"] = "incident/v0"
+    assert any("schema" in e
+               for e in preflight.validate_incident_bundle(bundle))
+    # an alert-triggered bundle with no evidence: capture raced ahead
+    # of evaluation
+    bundle = preflight.synthetic_incident_bundle()
+    bundle["trigger"]["evidence"] = {}
+    assert any("no evidence" in e
+               for e in preflight.validate_incident_bundle(bundle))
+    # a bundle that froze nothing
+    bundle = preflight.synthetic_incident_bundle()
+    bundle["history"]["window"] = []
+    assert any("froze nothing" in e
+               for e in preflight.validate_incident_bundle(bundle))
+    # a missing joined section
+    bundle = preflight.synthetic_incident_bundle()
+    del bundle["rounds"]
+    assert any("'rounds'" in e
+               for e in preflight.validate_incident_bundle(bundle))
+
+
+def test_alerts_check_must_fire_leg_can_fail(monkeypatch):
+    """Neuter gauge writes so the stall metric never climbs: the
+    must-fire leg of the alerts check has to report it."""
+    from generativeaiexamples_tpu.obs import metrics as obs_metrics
+
+    monkeypatch.setattr(obs_metrics.Gauge, "set",
+                        lambda self, value: None)
+    errors = preflight.check_alerts()
+    assert any("must-fire" in e for e in errors)
+
+
+def test_alerts_check_must_resolve_leg_can_fail(monkeypatch):
+    """Collapse the age-out sleep so the breach never leaves the rule
+    window: the must-resolve leg has to report the stuck-firing rule."""
+    import time
+
+    monkeypatch.setattr(time, "sleep", lambda s: None)
+    errors = preflight.check_alerts()
+    assert any("must-resolve" in e for e in errors)
+
+
 def test_metrics_docs_check_is_the_real_one(monkeypatch):
     """preflight's metrics-docs check is the same two-way checker the
     dedicated tier-1 test runs — doctor the doc text and it must

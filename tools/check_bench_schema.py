@@ -77,7 +77,8 @@ def validate_result(result: dict, schema: dict | None = None) -> None:
     _check_types("result", result, schema["top_level"], errors)
     for section in ("engine_pipeline", "engine_rounds", "e2e_ttft_dist_ms",
                     "chat", "openloop", "fleet", "capacity", "multichip",
-                    "kv_pressure", "autoscale", "disagg", "failover"):
+                    "kv_pressure", "autoscale", "disagg", "failover",
+                    "obs_overhead"):
         sub = result.get(section)
         if isinstance(sub, dict):
             _check_types(section, sub, schema[section], errors)

@@ -30,9 +30,17 @@ Two fenced tables, each enforced BOTH ways:
   must document exactly those names (``engine_round_*`` plus
   ``sched_cost_drift_ratio``).
 
-Registry-level metrics that are NOT part of either surface (the labeled
-``engine_stage_seconds`` histogram, ``shed_total``...) live OUTSIDE the
-fences and are not checked here.
+- **Process gauges.** The scrape-time process-resource mirror declares
+  its surface in ``obs.metrics.PROCESS_METRICS``; the table between
+
+      <!-- process-metrics:begin --> ... <!-- process-metrics:end -->
+
+  must document exactly those names.
+
+Registry-level metrics that are NOT part of any surface (the labeled
+``engine_stage_seconds`` histogram, ``shed_total``, the alerting
+``alerts_firing``/``alerts_total`` pair...) live OUTSIDE the fences and
+are not checked here.
 
 Runs in tier-1 via tests/test_metrics_docs.py; CLI:
 ``python tools/check_metrics_docs.py`` exits non-zero listing every
@@ -53,6 +61,8 @@ ROUTER_BEGIN = "<!-- router-metrics:begin -->"
 ROUTER_END = "<!-- router-metrics:end -->"
 ROUNDS_BEGIN = "<!-- round-metrics:begin -->"
 ROUNDS_END = "<!-- round-metrics:end -->"
+PROCESS_BEGIN = "<!-- process-metrics:begin -->"
+PROCESS_END = "<!-- process-metrics:end -->"
 
 _GAUGE_RE = re.compile(r"`engine_([a-z0-9_]+)`")
 _ROUTER_RE = re.compile(r"`router_([a-z0-9_]+)")  # name may carry {label=}
@@ -110,6 +120,17 @@ def expected_round_metrics() -> set[str]:
     return set(ROUND_METRICS)
 
 
+def documented_process_metrics(doc_text: str) -> set[str]:
+    """process_* names inside the process fence (backtick-quoted)."""
+    return set(_ROUNDS_RE.findall(
+        _fenced(doc_text, PROCESS_BEGIN, PROCESS_END)))
+
+
+def expected_process_metrics() -> set[str]:
+    from generativeaiexamples_tpu.obs.metrics import PROCESS_METRICS
+    return {name for name, _ in PROCESS_METRICS}
+
+
 def check(doc_text: str | None = None) -> list[str]:
     """Every mismatch between the docs tables and the code surfaces;
     empty on a clean tree."""
@@ -150,6 +171,18 @@ def check(doc_text: str | None = None) -> list[str]:
             f"obs.rounds.ROUND_METRICS declares {name} but "
             f"docs/observability.md's round-telemetry table does not "
             f"document it")
+    doc_process = documented_process_metrics(doc_text)
+    process = expected_process_metrics()
+    for name in sorted(doc_process - process):
+        errors.append(
+            f"docs/observability.md documents {name} but "
+            f"obs.metrics.PROCESS_METRICS has no such gauge (stale doc "
+            f"after a process-telemetry rename?)")
+    for name in sorted(process - doc_process):
+        errors.append(
+            f"obs.metrics.PROCESS_METRICS declares {name} but "
+            f"docs/observability.md's process table does not document "
+            f"it")
     return errors
 
 

@@ -1,0 +1,270 @@
+"""Render an incident black-box bundle (obs/incidents.py, schema
+``incident/v1``) into a markdown post-mortem.
+
+The bundle is the frozen evidence — this tool is the narrative: what
+fired and why (the rule's evidence values against its threshold), what
+the metric history looked like across the window, and the per-request
+story — each flight timeline joined to the engine round records that
+granted it tokens by the forwarded ``X-Request-ID`` (the cross-layer
+trace key docs/observability.md describes).
+
+Importable (``render_markdown(bundle) -> str`` — the tests and preflight
+validator drive it that way) and a CLI::
+
+    python tools/incident_report.py $GAIE_RUN_DIR/incidents/<id>.json
+    python tools/incident_report.py --latest   # newest bundle in the store
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _ts(unix_s) -> str:
+    try:
+        return time.strftime("%Y-%m-%d %H:%M:%S UTC",
+                             time.gmtime(float(unix_s)))
+    except (TypeError, ValueError):
+        return "?"
+
+
+def _fmt(v) -> str:
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return str(v)
+
+
+def _trigger_section(bundle: dict) -> list[str]:
+    trig = bundle.get("trigger") or {}
+    lines = [f"# Incident {bundle.get('id', '?')}", ""]
+    lines.append(f"- **server**: {bundle.get('server', '?')}")
+    lines.append(f"- **captured**: {_ts(bundle.get('ts'))}")
+    lines.append(f"- **trigger**: {trig.get('kind', '?')}"
+                 + (f" — rule `{trig['rule']}`" if trig.get("rule")
+                    else f" — {trig.get('reason', '')}"))
+    if trig.get("severity"):
+        lines.append(f"- **severity**: {trig['severity']}")
+    if trig.get("summary"):
+        lines.append(f"- **summary**: {trig['summary']}")
+    evidence = trig.get("evidence") or {}
+    series = evidence.get("series") or {}
+    if series:
+        lines += ["", "## Evidence", "",
+                  f"`{evidence.get('metric', '?')}` "
+                  f"{evidence.get('agg', '?')} "
+                  f"{evidence.get('op', '?')} "
+                  f"{_fmt(evidence.get('threshold', '?'))} over "
+                  f"{_fmt(evidence.get('window_s', '?'))}s "
+                  f"({evidence.get('samples', 0)} samples, "
+                  f"{_fmt(evidence.get('span_s', 0))}s span):", ""]
+        lines.append("| series | value | last | min | max | avg |")
+        lines.append("|---|---|---|---|---|---|")
+        for key in sorted(series):
+            row = series[key]
+            aggs = row.get("aggregates") or {}
+            lines.append(
+                f"| `{key}` | {_fmt(row.get('value', '?'))} | "
+                f"{_fmt(aggs.get('last', ''))} | {_fmt(aggs.get('min', ''))}"
+                f" | {_fmt(aggs.get('max', ''))} | "
+                f"{_fmt(aggs.get('avg', ''))} |")
+    return lines
+
+
+def _alerts_section(bundle: dict) -> list[str]:
+    alerts = bundle.get("alerts") or {}
+    rules = alerts.get("rules") or []
+    if not rules:
+        return []
+    lines = ["", "## Alert states at capture", "",
+             "| rule | state | severity | since | summary |",
+             "|---|---|---|---|---|"]
+    for r in rules:
+        lines.append(f"| `{r.get('rule', '?')}` | {r.get('state', '?')} | "
+                     f"{r.get('severity', '')} | "
+                     f"{_ts(r.get('since')) if r.get('since') else ''} | "
+                     f"{r.get('summary', '')} |")
+    return lines
+
+
+def _history_section(bundle: dict) -> list[str]:
+    hist = (bundle.get("history") or {}).get("aggregates") or {}
+    series = hist.get("series") or {}
+    if not series:
+        return []
+    lines = ["", "## Metric history "
+             f"({hist.get('samples', 0)} samples, "
+             f"{_fmt(hist.get('span_s', 0))}s span, interval "
+             f"{_fmt(hist.get('interval_s', '?'))}s)", "",
+             "| metric | kind | last | min | max | avg | rate/s |",
+             "|---|---|---|---|---|---|---|"]
+    for key in sorted(series):
+        s = series[key]
+        lines.append(
+            f"| `{key}` | {s.get('kind', '?')} | {_fmt(s.get('last', ''))} "
+            f"| {_fmt(s.get('min', ''))} | {_fmt(s.get('max', ''))} | "
+            f"{_fmt(s.get('avg', ''))} | "
+            f"{_fmt(s.get('rate_per_s', '')) if 'rate_per_s' in s else ''}"
+            f" |")
+    return lines
+
+
+def _round_index(bundle: dict) -> dict[str, list[dict]]:
+    """request_id -> round records that granted it tokens (the
+    X-Request-ID join: flight timelines and round plans share the id)."""
+    idx: dict[str, list[dict]] = {}
+    recs = (bundle.get("rounds") or {}).get("rounds") or []
+    for rec in recs:
+        for grant in (rec.get("plan") or {}).get("prefill_grants") or []:
+            rid = grant.get("request_id")
+            if rid:
+                idx.setdefault(rid, []).append(rec)
+    return idx
+
+
+def _requests_section(bundle: dict) -> list[str]:
+    flight = bundle.get("flight") or {}
+    timelines = list(flight.get("in_flight") or []) \
+        + list(flight.get("completed") or [])
+    if not timelines:
+        return []
+    rounds_by_rid = _round_index(bundle)
+    lines = ["", "## Requests (flight ⋈ rounds by X-Request-ID)"]
+    for tl in timelines:
+        rid = tl.get("request_id", "?")
+        meta = tl.get("meta") or {}
+        lines += ["", f"### `{rid}`", ""]
+        state = "in flight" if not tl.get("done") else \
+            str(meta.get("outcome", "done"))
+        started = _ts((tl.get("started_unix_ms") or 0) / 1e3)
+        lines.append(f"- started {started}, {state}")
+        for k in ("path", "replica", "status", "ttft_ms", "duration_ms"):
+            if k in meta:
+                lines.append(f"- {k}: {_fmt(meta[k])}")
+        events = tl.get("events") or []
+        if events:
+            lines.append(f"- events ({len(events)}): " + ", ".join(
+                f"{e.get('event', '?')}@{_fmt(e.get('t_ms', 0))}ms"
+                for e in events[:12])
+                + (" …" if len(events) > 12 else ""))
+        joined = rounds_by_rid.get(rid) or []
+        if joined:
+            lines.append(f"- engine rounds granting this request "
+                         f"({len(joined)}):")
+            for rec in joined[:8]:
+                ex = rec.get("execution") or {}
+                out = rec.get("outcome") or {}
+                lines.append(
+                    f"  - round `{rec.get('round_id', '?')}` "
+                    f"[{rec.get('kind', '?')}] device "
+                    f"{_fmt(ex.get('device_ms', 0))}ms, emitted "
+                    f"{out.get('tokens_emitted', 0)} tokens")
+    return lines
+
+
+def _rounds_section(bundle: dict) -> list[str]:
+    rounds = bundle.get("rounds") or {}
+    agg = rounds.get("aggregates") or {}
+    recs = rounds.get("rounds") or []
+    if not (agg or recs):
+        return []
+    lines = ["", f"## Engine rounds ({len(recs)} records retained)"]
+    if agg:
+        lines.append("")
+        for k in sorted(agg):
+            lines.append(f"- {k}: {_fmt(agg[k])}")
+    return lines
+
+
+def _fleet_section(bundle: dict) -> list[str]:
+    lines: list[str] = []
+    fleet = bundle.get("fleet")
+    if fleet:
+        totals = fleet.get("totals") or {}
+        lines += ["", "## Fleet at capture", ""]
+        for k in sorted(totals):
+            lines.append(f"- {k}: {_fmt(totals[k])}")
+        reps = fleet.get("replicas") or []
+        if reps:
+            lines += ["", "| replica | state |", "|---|---|"]
+            for r in reps:
+                name = r.get("name", "?")
+                state = r.get("state") or (
+                    "placeable" if r.get("placeable") else "out")
+                lines.append(f"| `{name}` | {state} |")
+    replicas = bundle.get("replicas") or {}
+    if replicas:
+        lines += ["", "## Per-replica debug slices", ""]
+        for name in sorted(replicas):
+            row = replicas[name] or {}
+            req = row.get("requests") or {}
+            rnd = row.get("rounds") or {}
+            n_req = len(req.get("completed") or []) \
+                + len(req.get("in_flight") or [])
+            n_rnd = len(rnd.get("rounds") or [])
+            lines.append(f"- `{name}`: {n_req} flight timelines, "
+                         f"{n_rnd} round records"
+                         + ("" if req or rnd else " (unreachable)"))
+    auto = bundle.get("autoscale")
+    if auto and auto.get("decisions"):
+        lines += ["", "## Autoscale decisions", ""]
+        for d in auto["decisions"][:10]:
+            lines.append(f"- {d.get('action', '?')} "
+                         f"(reason: {d.get('reason', '?')})")
+    return lines
+
+
+def render_markdown(bundle: dict) -> str:
+    """The whole post-mortem for one bundle."""
+    lines = _trigger_section(bundle)
+    lines += _alerts_section(bundle)
+    lines += _history_section(bundle)
+    lines += _fleet_section(bundle)
+    lines += _rounds_section(bundle)
+    lines += _requests_section(bundle)
+    lines.append("")
+    return "\n".join(lines)
+
+
+def _latest_bundle_path() -> str | None:
+    sys.path.insert(0, REPO)
+    from generativeaiexamples_tpu.obs.incidents import incident_root
+    paths = glob.glob(os.path.join(incident_root(), "*.json"))
+    return max(paths, key=os.path.getmtime) if paths else None
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("bundle", nargs="?", help="path to a bundle JSON")
+    ap.add_argument("--latest", action="store_true",
+                    help="render the newest bundle in the incident store")
+    args = ap.parse_args(argv)
+    path = args.bundle
+    if args.latest and not path:
+        path = _latest_bundle_path()
+        if path is None:
+            print("no incident bundles on disk", file=sys.stderr)
+            return 1
+    if not path:
+        ap.error("need a bundle path or --latest")
+    try:
+        with open(path, encoding="utf-8") as fh:
+            bundle = json.load(fh)
+    except (OSError, ValueError) as exc:
+        print(f"cannot read {path}: {exc}", file=sys.stderr)
+        return 1
+    try:
+        print(render_markdown(bundle))
+    except BrokenPipeError:                      # |head closed the pipe
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -60,6 +60,11 @@ metric                                  direction  source
                                                    must protect decode
                                                    rounds, not just
                                                    TTFT
+``obs_overhead.overhead_pct``           lower      obs-overhead scenario
+                                                   — armed vs disarmed
+                                                   decode tok/s cost of
+                                                   the telemetry layer
+``obs_overhead.armed_tokens_per_sec``   higher     obs-overhead scenario
 ======================================  =========  =====================
 
 Accepts raw bench results or the driver's artifact wrapper (an object
@@ -120,6 +125,12 @@ _DISAGG_DIRECTIONS = {"ttft_p50_ms": "lower",
 #: contributes nothing there.)
 _FAILOVER_DIRECTIONS = {"completed_no_error_rate": "higher",
                         "resumed_added_p50_ms": "lower"}
+#: Observability-overhead scenario: the armed arm (history sampler +
+#: alert engine ticking at a tight interval) must stay within budget of
+#: the disarmed arm — overhead percent DOWN, armed decode tok/s UP. The
+#: disarmed arm is the reference and is not gated on its own.
+_OBS_OVERHEAD_DIRECTIONS = {"overhead_pct": "lower",
+                            "armed_tokens_per_sec": "higher"}
 
 DEFAULT_THRESHOLD_PCT = 5.0
 
@@ -215,6 +226,12 @@ def extract_metrics(result: dict) -> dict[str, tuple[float, str]]:
                 v = _num(entry.get(key))
                 if v is not None:
                     out[f"disagg.{key}@{arm}"] = (v, direction)
+    obs = result.get("obs_overhead")
+    if isinstance(obs, dict):
+        for key, direction in _OBS_OVERHEAD_DIRECTIONS.items():
+            v = _num(obs.get(key))
+            if v is not None:
+                out[f"obs_overhead.{key}"] = (v, direction)
     failover = result.get("failover")
     if isinstance(failover, dict):
         for entry in failover.get("arms") or []:

@@ -48,6 +48,16 @@ Checks:
   disagg arm's role census actually splits prefill/decode, and its
   handoff accounting shows the two-leg path ran — a disagg arm with
   zero handoffs AND zero fallbacks silently degenerated to unified).
+- **alerts** — a REAL ``obs.alerts.AlertEngine`` ticked over a
+  synthetic-but-real metric history through a whole episode: the
+  watchdog rule must FIRE on climbing stall deltas (``on_fire`` exactly
+  once) and must RESOLVE when the breach ages out of the rule window;
+  the incident bundle built from the firing validates against the
+  ``incident/v1`` contract and renders via ``tools/incident_report.py``.
+- **obs-overhead** — the ``BENCH_OBS_OVERHEAD`` scenario's
+  ``obs_overhead`` section contract: schema plus the semantic
+  invariants (armed arm actually sampling, overhead arithmetic
+  consistent with the two arms).
 - **perf-gates** — ``tools/perf_diff.py`` over committed artifact
   pairs: each later round must not regress the earlier one's headline
   metrics (the same pairs/thresholds the tier-1 perf_diff test pins).
@@ -153,7 +163,7 @@ def check_bench_schema() -> list[str]:
         kv_pool_pages=8, device="cpu", rtt_ms=None, n_devices=1,
         bench_seconds=1.0, fleet=fleet, kv_pressure=kv_pressure,
         autoscale=autoscale, multichip=synthetic_multichip(),
-        disagg=synthetic_disagg())
+        disagg=synthetic_disagg(), obs_overhead=synthetic_obs_overhead())
     try:
         validate_result(result)
     except BenchSchemaError as exc:
@@ -418,6 +428,234 @@ def check_failover() -> list[str]:
     return validate_failover_block(synthetic_failover())
 
 
+def synthetic_obs_overhead() -> dict:
+    """A fully-populated ``obs_overhead`` bench section (the
+    BENCH_OBS_OVERHEAD scenario's output shape: armed history sampler +
+    alert engine vs HISTORY_INTERVAL_S=0 disarmed, decode tok/s each
+    way) — shared by the bench-schema synthetic result and the
+    obs-overhead check below; returned fresh so the tier-1 test can
+    doctor a copy to prove the check fails."""
+    return {
+        "history_interval_s": 0.05, "history_window_s": 10.0,
+        "alert_rules": 5, "rounds_per_arm": 8,
+        "armed_tokens_per_sec": 99.2, "disarmed_tokens_per_sec": 100.0,
+        "armed_samples": 40, "overhead_pct": 0.8,
+    }
+
+
+def validate_obs_overhead_block(block: dict) -> list[str]:
+    """Element-wise + semantic validation of one ``obs_overhead``
+    section: schema, both arms measured (positive tok/s), the armed arm
+    actually sampling (zero samples means the sampler never ran — the
+    arm measured a disarmed stack twice), and ``overhead_pct``
+    arithmetically consistent with the two arms."""
+    sys.path.insert(0, REPO)
+    from tools.check_bench_schema import (BenchSchemaError, load_schema,
+                                          validate_result)
+    errors: list[str] = []
+    try:
+        validate_result({"obs_overhead": block},
+                        schema={**load_schema(),
+                                "top_level": {"obs_overhead": ["obj"]}})
+    except BenchSchemaError as exc:
+        errors.append(str(exc))
+    armed = block.get("armed_tokens_per_sec")
+    disarmed = block.get("disarmed_tokens_per_sec")
+    for name, v in (("armed_tokens_per_sec", armed),
+                    ("disarmed_tokens_per_sec", disarmed)):
+        if not (isinstance(v, (int, float)) and not isinstance(v, bool)
+                and v > 0):
+            errors.append(f"{name} must be a positive rate, got {v!r}")
+    if not block.get("history_interval_s", 0) > 0:
+        errors.append("history_interval_s must be > 0 — the armed arm "
+                      "ran with the layer disarmed")
+    if not block.get("armed_samples", 0) > 0:
+        errors.append("armed_samples is 0 — the sampler never ran; the "
+                      "armed arm measured a disarmed stack")
+    if isinstance(armed, (int, float)) and isinstance(disarmed,
+                                                      (int, float)) \
+            and disarmed > 0:
+        expect = (disarmed - armed) / disarmed * 100.0
+        got = block.get("overhead_pct")
+        if not (isinstance(got, (int, float))
+                and abs(got - expect) <= 0.5):
+            errors.append(
+                f"overhead_pct {got!r} does not match the arms "
+                f"((disarmed-armed)/disarmed*100 = {expect:.3f})")
+    return errors
+
+
+def check_obs_overhead() -> list[str]:
+    """Validate the obs-overhead scenario contract over the synthetic
+    section — the same validator bench consumers can run over a real
+    BENCH_OBS_OVERHEAD artifact."""
+    return validate_obs_overhead_block(synthetic_obs_overhead())
+
+
+def synthetic_incident_bundle() -> dict:
+    """An incident bundle built through the REAL pipeline: a fresh
+    registry + history ring sampled over a breaching metric, a real
+    AlertEngine firing the watchdog rule, and ``build_bundle`` joining
+    history + alert evidence + a flight timeline. Returned fresh so the
+    tier-1 test can doctor a copy to prove the validator fails."""
+    sys.path.insert(0, REPO)
+    from generativeaiexamples_tpu.obs import alerts as obs_alerts
+    from generativeaiexamples_tpu.obs import flight as obs_flight
+    from generativeaiexamples_tpu.obs import history as obs_history
+    from generativeaiexamples_tpu.obs import incidents as obs_incidents
+    from generativeaiexamples_tpu.obs import metrics as obs_metrics
+
+    reg = obs_metrics.Registry()
+    stalls = reg.gauge("engine_watchdog_stalls",
+                       "cumulative watchdog stall count (mirror)")
+    hist = obs_history.MetricHistory(registry=reg, window_s=30.0,
+                                     interval_s=0.01)
+    rule = obs_alerts.AlertRule(
+        "engine_watchdog_stall", "engine_watchdog_stalls", "delta", ">",
+        0.0, window_s=30.0, severity="critical",
+        summary="engine serve loop stalled (watchdog fired)")
+    fired: list[dict] = []
+    engine = obs_alerts.AlertEngine(
+        hist, rules=(rule,), registry=reg,
+        on_fire=lambda r, rec: fired.append(rec))
+    for v in (0.0, 1.0, 2.0):
+        stalls.set(v)
+        hist.sample_once()
+        engine.tick()
+    record = fired[0] if fired else {"state": None, "evidence": {}}
+    flight = obs_flight.FlightRecorder()
+    tl = flight.begin("preflight-req-1")
+    flight.complete(tl)
+    trigger = {"kind": "alert", "rule": rule.name,
+               "severity": rule.severity, "summary": rule.summary,
+               "state": record.get("state"),
+               "evidence": record.get("evidence", {})}
+    bundle = obs_incidents.build_bundle(
+        server="chain", trigger=trigger, history=hist, alerts=engine,
+        flight=flight, rounds=None)
+    bundle["id"] = "inc-preflight-1-engine_watchdog_stall"
+    return bundle
+
+
+def validate_incident_bundle(bundle: dict) -> list[str]:
+    """Element-wise validation of one incident bundle against the
+    ``incident/v1`` contract: the joined sections all present, an
+    alert-triggered bundle carrying real evidence, a non-empty history
+    window, and the markdown renderer able to tell the story."""
+    sys.path.insert(0, REPO)
+    from generativeaiexamples_tpu.obs.incidents import BUNDLE_SCHEMA
+    from tools.incident_report import render_markdown
+
+    errors: list[str] = []
+    if bundle.get("schema") != BUNDLE_SCHEMA:
+        errors.append(f"schema is {bundle.get('schema')!r}, expected "
+                      f"{BUNDLE_SCHEMA!r}")
+    for key in ("server", "ts", "trigger", "alerts", "history", "flight",
+                "rounds"):
+        if key not in bundle:
+            errors.append(f"bundle is missing the {key!r} section")
+    trigger = bundle.get("trigger") or {}
+    if trigger.get("kind") not in ("alert", "manual"):
+        errors.append(f"trigger kind {trigger.get('kind')!r} is not "
+                      f"alert|manual")
+    if trigger.get("kind") == "alert":
+        if not trigger.get("rule"):
+            errors.append("alert-triggered bundle names no rule")
+        if not (trigger.get("evidence") or {}).get("series"):
+            errors.append("alert-triggered bundle carries no evidence "
+                          "series — capture ran before evaluation?")
+    hist = bundle.get("history") or {}
+    if not hist.get("window"):
+        errors.append("history window is empty — the bundle froze "
+                      "nothing")
+    agg = hist.get("aggregates") or {}
+    if agg and not agg.get("series"):
+        errors.append("history aggregates carry no series")
+    if errors:
+        return errors
+    try:
+        rendered = render_markdown(bundle)
+    except Exception as exc:  # noqa: BLE001 — the check must report
+        return [f"incident_report.render_markdown raised: {exc!r}"]
+    if trigger.get("rule") and trigger["rule"] not in rendered:
+        errors.append("rendered report does not mention the firing rule")
+    if bundle.get("id") and bundle["id"] not in rendered:
+        errors.append("rendered report does not carry the incident id")
+    return errors
+
+
+def check_alerts() -> list[str]:
+    """Drive a REAL AlertEngine over a synthetic-but-real MetricHistory
+    through the whole episode — must-fire (watchdog stalls climb →
+    firing, on_fire exactly once), no re-capture while it stays firing,
+    must-resolve (the breach ages out of the rule window → resolved) —
+    then validate the incident bundle the firing built. Both the fire
+    leg and the resolve leg are provable-to-fail: the tier-1 test
+    doctors the inputs each way."""
+    import time as _time
+
+    sys.path.insert(0, REPO)
+    from generativeaiexamples_tpu.obs import alerts as obs_alerts
+    from generativeaiexamples_tpu.obs import history as obs_history
+    from generativeaiexamples_tpu.obs import metrics as obs_metrics
+
+    errors: list[str] = []
+    reg = obs_metrics.Registry()
+    stalls = reg.gauge("engine_watchdog_stalls",
+                       "cumulative watchdog stall count (mirror)")
+    hist = obs_history.MetricHistory(registry=reg, window_s=30.0,
+                                     interval_s=0.01)
+    # A short rule window so the resolve leg can age the breach out in
+    # tens of milliseconds instead of minutes.
+    rule = obs_alerts.AlertRule(
+        "engine_watchdog_stall", "engine_watchdog_stalls", "delta", ">",
+        0.0, window_s=0.05, severity="critical",
+        summary="engine serve loop stalled (watchdog fired)")
+    fired: list[dict] = []
+    engine = obs_alerts.AlertEngine(
+        hist, rules=(rule,), registry=reg,
+        on_fire=lambda r, rec: fired.append(rec))
+    for v in (0.0, 1.0, 2.0):
+        stalls.set(v)
+        hist.sample_once()
+        engine.tick()
+    if engine.firing() != [rule.name]:
+        errors.append(f"must-fire: watchdog deltas did not fire the "
+                      f"rule (firing={engine.firing()!r})")
+    if len(fired) != 1:
+        errors.append(f"on_fire ran {len(fired)} times during the "
+                      f"firing transition; the episode contract is "
+                      f"exactly once")
+    # Staying firing must not re-fire (the no-re-capture pin).
+    hist.sample_once()
+    engine.tick()
+    if len(fired) > 1:
+        errors.append("on_fire re-ran while the rule STAYED firing — "
+                      "every sustained alert would re-capture a bundle")
+    vals = reg.snapshot()
+    if vals.get('alerts_firing{rule="engine_watchdog_stall"}') != 1.0:
+        errors.append("alerts_firing gauge is not 1 while firing")
+    # Must-resolve: let the breach age past the rule window, then
+    # sample flat values — the delta collapses and the rule clears.
+    _time.sleep(0.08)
+    for _ in range(2):
+        hist.sample_once()
+        engine.tick()
+    if engine.firing():
+        errors.append(f"must-resolve: rule still firing after the "
+                      f"breach aged out (firing={engine.firing()!r})")
+    vals = reg.snapshot()
+    if vals.get('alerts_firing{rule="engine_watchdog_stall"}') != 0.0:
+        errors.append("alerts_firing gauge did not drop to 0 on "
+                      "resolve")
+    if vals.get('alerts_total{rule="engine_watchdog_stall",'
+                'state="resolved"}') != 1.0:
+        errors.append("alerts_total did not count the resolved "
+                      "transition")
+    errors.extend(validate_incident_bundle(synthetic_incident_bundle()))
+    return errors
+
+
 def check_multichip() -> list[str]:
     """Validate the multichip sweep contract over the synthetic section
     (schema + mesh-label/device/budget/tail invariants) — the same
@@ -633,6 +871,8 @@ CHECKS: dict[str, Callable[[], list[str]]] = {
     "multichip": check_multichip,
     "disagg": check_disagg,
     "failover": check_failover,
+    "alerts": check_alerts,
+    "obs-overhead": check_obs_overhead,
     "perf-gates": check_perf_gates,
 }
 
